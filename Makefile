@@ -1,0 +1,19 @@
+# Test/bench entry points.
+#
+# Tests run on a virtual 8-device CPU mesh (the JAX analog of Spark local[8])
+# with the axon TPU sitecustomize registration disabled — see
+# tests/conftest.py for why the env prefix is required.
+
+TEST_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+	XLA_FLAGS=--xla_force_host_platform_device_count=8 KERAS_BACKEND=jax
+
+.PHONY: test test-fast bench
+
+test:
+	$(TEST_ENV) python -m pytest tests/ -x -q
+
+test-fast:
+	$(TEST_ENV) python -m pytest tests/ -x -q -m "not slow"
+
+bench:
+	KERAS_BACKEND=jax python bench.py

@@ -1,0 +1,123 @@
+"""Benchmark harness: MNIST-MLP training throughput through ``SparkModel.fit``.
+
+The reference publishes no numbers (BASELINE.md) — this harness *establishes*
+the baseline the north star asks for: samples/sec/chip for the
+``examples/mnist_mlp_spark.py``-equivalent workload (MNIST-shaped MLP,
+synchronous mode) on whatever devices are visible, compared against plain
+single-device Keras ``model.fit`` on the same chip (the "single-GPU
+equivalent" denominator available in this environment).
+
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": "samples/sec/chip", "vs_baseline": N}``
+where ``vs_baseline`` = (our per-chip throughput) / (plain Keras-JAX
+``model.fit`` per-chip throughput) — >1.0 means the framework's compiled
+whole-run engine beats stock Keras on the identical model+data.
+
+Run single-process with the default (TPU) env; set ``BENCH_DEVICES=n`` to cap
+device count, ``BENCH_SAMPLES``/``BENCH_EPOCHS`` to resize.
+"""
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+
+
+def log(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+def make_model(input_dim, nb_classes):
+    import keras
+
+    # The reference example's MLP shape (mnist_mlp_spark.py: 784-128-128-10
+    # with dropout).
+    model = keras.Sequential(
+        [
+            keras.layers.Dense(128, activation="relu"),
+            keras.layers.Dropout(0.2),
+            keras.layers.Dense(128, activation="relu"),
+            keras.layers.Dropout(0.2),
+            keras.layers.Dense(nb_classes, activation="softmax"),
+        ]
+    )
+    model.build((None, input_dim))
+    model.compile(
+        optimizer="adam", loss="categorical_crossentropy", metrics=["accuracy"]
+    )
+    return model
+
+
+def main():
+    import numpy as np
+
+    import jax
+
+    n = int(os.environ.get("BENCH_SAMPLES", 65536))
+    epochs = int(os.environ.get("BENCH_EPOCHS", 4))
+    batch = int(os.environ.get("BENCH_BATCH", 128))
+    d, c = 784, 10
+
+    devices = jax.devices()
+    n_dev = int(os.environ.get("BENCH_DEVICES", len(devices)))
+    log(f"devices: {len(devices)} x {devices[0].platform}, using {n_dev}")
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, d)).astype("float32")
+    w = rng.normal(size=(d, c))
+    y = np.eye(c, dtype="float32")[(x @ w).argmax(1)]
+
+    # -- baseline: stock Keras-JAX fit on one device ----------------------
+    base_model = make_model(d, c)
+    base_model.fit(x[:4096], y[:4096], epochs=1, batch_size=batch, verbose=0)  # warmup/compile
+    t0 = time.perf_counter()
+    base_model.fit(x, y, epochs=epochs, batch_size=batch, verbose=0, shuffle=True)
+    t_base = time.perf_counter() - t0
+    base_sps = n * epochs / t_base
+    log(f"keras baseline: {t_base:.2f}s -> {base_sps:,.0f} samples/sec (1 device)")
+
+    # -- elephas_tpu: SparkModel.fit, synchronous fast path ---------------
+    from elephas_tpu import SparkModel
+    from elephas_tpu.data import SparkContext
+    from elephas_tpu.parallel.mesh import build_mesh
+    from elephas_tpu.utils import to_simple_rdd
+
+    mesh = build_mesh(n_dev)
+    sc = SparkContext(master=f"local[{n_dev}]", appName="bench")
+    rdd = to_simple_rdd(sc, x, y, num_slices=n_dev)
+    model = make_model(d, c)
+    spark_model = SparkModel(
+        model, mode="synchronous", num_workers=n_dev, mesh=mesh
+    )
+    # warmup: compile the whole-run program at the same geometry
+    spark_model.fit(rdd, epochs=epochs, batch_size=batch, verbose=0,
+                    validation_split=0.0)
+    t0 = time.perf_counter()
+    spark_model.fit(rdd, epochs=epochs, batch_size=batch, verbose=0,
+                    validation_split=0.0)
+    t_ours = time.perf_counter() - t0
+    ours_sps = n * epochs / t_ours
+    ours_sps_chip = ours_sps / n_dev
+    log(
+        f"elephas_tpu: {t_ours:.2f}s -> {ours_sps:,.0f} samples/sec total, "
+        f"{ours_sps_chip:,.0f} /chip over {n_dev} device(s)"
+    )
+    final_loss = spark_model.training_histories[-1]["loss"][-1]
+    log(f"final loss {final_loss:.4f} (sanity: must be finite & decreasing)")
+
+    print(
+        json.dumps(
+            {
+                "metric": "mnist_mlp_sync_samples_per_sec_per_chip",
+                "value": round(ours_sps_chip, 1),
+                "unit": "samples/sec/chip",
+                "vs_baseline": round(ours_sps_chip / base_sps, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

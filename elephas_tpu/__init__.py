@@ -1,0 +1,16 @@
+"""elephas_tpu — TPU-native distributed deep learning with the elephas API.
+
+A ground-up JAX/XLA rebuild of the capabilities of b13n3rd/elephas
+("Distributed Deep Learning with Keras & Spark"): Keras-3 models train
+data-parallel over a ``jax.sharding.Mesh``, with elephas's synchronous
+delta-averaging and asynchronous/hogwild parameter-server modes realized as
+XLA collectives over ICI (fast path) or a wire-compatible host parameter
+server (compatibility path). The Spark-facing surfaces are preserved over a
+local facade: see :mod:`elephas_tpu.data`.
+"""
+
+__version__ = "0.1.0"
+
+from .spark_model import SparkMLlibModel, SparkModel, load_spark_model
+
+__all__ = ["SparkModel", "SparkMLlibModel", "load_spark_model", "__version__"]

@@ -1,0 +1,12 @@
+from .dataframe import DataFrame, Row, SparkSession
+from .rdd import RDD, Broadcast, SparkConf, SparkContext
+
+__all__ = [
+    "RDD",
+    "Broadcast",
+    "SparkConf",
+    "SparkContext",
+    "DataFrame",
+    "Row",
+    "SparkSession",
+]

@@ -1,0 +1,10 @@
+from .adapters import KerasModelAdapter
+from .losses import resolve_accuracy, resolve_per_sample_loss
+from .optimizers import to_optax
+
+__all__ = [
+    "KerasModelAdapter",
+    "resolve_per_sample_loss",
+    "resolve_accuracy",
+    "to_optax",
+]

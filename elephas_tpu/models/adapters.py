@@ -1,0 +1,223 @@
+"""Keras-3 ↔ functional-JAX bridge.
+
+The reference ships a *stateful* Keras model to each executor and calls
+``model.fit`` (``elephas/worker.py:~25``). The TPU-native engine instead
+needs the model as a pure function so a whole training run can live inside one
+``jit``/``shard_map`` program: parameters in, parameters out, XLA collectives
+in the middle. :class:`KerasModelAdapter` provides that view over any built,
+compiled Keras-3 model (JAX backend) via ``model.stateless_call``:
+
+- splits/joins the flat ``get_weights()`` list (the reference's public weight
+  currency — deltas are computed over it, including BatchNorm statistics) into
+  the ``(trainable, non_trainable)`` variable lists ``stateless_call`` wants;
+- handles non-weight state (seed-generator variables for dropout live in
+  ``non_trainable_variables`` but not in ``weights``);
+- builds jit-ready train/eval steps: per-sample loss masked by sample weights
+  (so padded batches reproduce unpadded semantics), optax optimizer update,
+  whole-step gated off for all-padding batches so optimizer momentum cannot
+  drift on steps the reference never ran.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .losses import resolve_accuracy, resolve_per_sample_loss
+from .optimizers import to_optax
+
+
+def _tree_where(cond, new, old):
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(cond, a, b) if hasattr(a, "dtype") else a, new, old
+    )
+
+
+class KerasModelAdapter:
+    """Functional view over a built & compiled Keras-3 model."""
+
+    def __init__(self, model, loss: Any = None, optimizer: Any = None,
+                 metrics: Optional[Sequence[str]] = None,
+                 custom_objects: Optional[dict] = None):
+        if not model.built:
+            raise ValueError(
+                "KerasModelAdapter requires a built model (call model.build(...) "
+                "or run data through it once)."
+            )
+        self.model = model
+        self.custom_objects = custom_objects
+        self.loss_spec = loss if loss is not None else getattr(model, "loss", None)
+        if self.loss_spec is None:
+            raise ValueError(
+                "No loss available: compile the model or pass loss= explicitly."
+            )
+        self.optimizer_spec = (
+            optimizer if optimizer is not None else getattr(model, "optimizer", None)
+        ) or "sgd"
+        self.metrics = list(metrics) if metrics is not None else self._infer_metrics()
+
+        # Index mapping: flat get_weights() order ↔ (trainable, non_trainable).
+        pos = {id(v): i for i, v in enumerate(model.weights)}
+        self._tv_idx = [pos[id(v)] for v in model.trainable_variables]
+        # non_trainable_variables may contain non-weight state (seed
+        # generators); those have no slot in get_weights().
+        self._ntv_slots: List[Optional[int]] = [
+            pos.get(id(v)) for v in model.non_trainable_variables
+        ]
+
+    # -- introspection ---------------------------------------------------
+    def _infer_metrics(self) -> List[str]:
+        names: List[str] = []
+
+        def scan(spec):
+            if spec is None:
+                return
+            if isinstance(spec, (list, tuple)):
+                for s in spec:
+                    scan(s)
+                return
+            n = spec if isinstance(spec, str) else getattr(spec, "name", "")
+            if "accuracy" in str(n) or str(n) in ("acc",):
+                names.append("accuracy")
+
+        # Keras 3 keeps the raw compile(metrics=...) specs on the
+        # CompileMetrics container (unbuilt until first train step).
+        cm = getattr(self.model, "_compile_metrics", None)
+        scan(getattr(cm, "_user_metrics", None))
+        try:
+            for m in self.model.metrics:
+                scan(getattr(m, "name", ""))
+        except Exception:
+            pass
+        return sorted(set(names))
+
+    @property
+    def wants_accuracy(self) -> bool:
+        return "accuracy" in self.metrics
+
+    # -- serialization (reference: utils/serialization.py) ---------------
+    @classmethod
+    def from_json(cls, json_config: str, weights: Optional[List[np.ndarray]] = None,
+                  loss: Any = None, optimizer: Any = None,
+                  metrics: Optional[Sequence[str]] = None,
+                  custom_objects: Optional[dict] = None) -> "KerasModelAdapter":
+        import keras
+
+        model = keras.models.model_from_json(json_config, custom_objects=custom_objects)
+        if weights is not None:
+            model.set_weights(weights)
+        return cls(model, loss=loss, optimizer=optimizer, metrics=metrics,
+                   custom_objects=custom_objects)
+
+    # -- state conversion ------------------------------------------------
+    def get_weights(self) -> List[np.ndarray]:
+        return self.model.get_weights()
+
+    def set_weights(self, weights: Sequence[np.ndarray]) -> None:
+        self.model.set_weights(list(weights))
+
+    def state_values(self) -> Tuple[List, List]:
+        """Current ``(trainable, non_trainable)`` variable values."""
+        tv = [v.value for v in self.model.trainable_variables]
+        ntv = [v.value for v in self.model.non_trainable_variables]
+        return tv, ntv
+
+    def weights_to_state(self, flat: Sequence) -> Tuple[List, List]:
+        """Flat ``get_weights()`` list → ``(tv, ntv)`` for ``stateless_call``.
+
+        Non-weight state (seed generators) takes the model's current values.
+        """
+        flat = list(flat)
+        tv = [flat[i] for i in self._tv_idx]
+        ntv = []
+        for slot, var in zip(self._ntv_slots, self.model.non_trainable_variables):
+            ntv.append(flat[slot] if slot is not None else var.value)
+        return tv, ntv
+
+    def state_to_weights(self, tv: Sequence, ntv: Sequence) -> List:
+        """``(tv, ntv)`` → flat list in ``get_weights()`` order."""
+        flat: List = [None] * len(self.model.weights)
+        for value, i in zip(tv, self._tv_idx):
+            flat[i] = value
+        for value, slot in zip(ntv, self._ntv_slots):
+            if slot is not None:
+                flat[slot] = value
+        return flat
+
+    def install_state(self, tv: Sequence, ntv: Sequence) -> None:
+        """Assign ``(tv, ntv)`` back into the live Keras variables."""
+        for var, value in zip(self.model.trainable_variables, tv):
+            var.assign(np.asarray(value))
+        for var, value in zip(self.model.non_trainable_variables, ntv):
+            var.assign(np.asarray(value))
+
+    # -- compiled-step builders ------------------------------------------
+    def make_optimizer(self):
+        return to_optax(self.optimizer_spec)
+
+    def build_train_step(self, optimizer) -> Callable:
+        """``(tv, ntv, opt_state, x, y, sw) → (tv, ntv, opt_state, stats)``.
+
+        ``stats`` is ``(loss_weighted_sum, acc_weighted_sum, weight_sum)`` so
+        callers can aggregate exact weighted means across steps/workers.
+        """
+        model = self.model
+        per_sample_loss = resolve_per_sample_loss(self.loss_spec)
+        acc_fn = resolve_accuracy(self.loss_spec) if self.wants_accuracy else None
+
+        def train_step(tv, ntv, opt_state, x, y, sw):
+            def _loss(tv_):
+                y_pred, ntv2 = model.stateless_call(tv_, ntv, x, training=True)
+                per = per_sample_loss(y, y_pred)
+                wsum = jnp.sum(sw)
+                loss = jnp.sum(per * sw) / jnp.maximum(wsum, 1e-9)
+                return loss, (ntv2, y_pred)
+
+            (loss, (ntv2, y_pred)), grads = jax.value_and_grad(
+                _loss, has_aux=True
+            )(tv)
+            updates, opt2 = optimizer.update(grads, opt_state, tv)
+            tv2 = jax.tree_util.tree_map(jnp.add, tv, updates)
+
+            wsum = jnp.sum(sw)
+            valid = wsum > 0
+            tv2 = _tree_where(valid, tv2, tv)
+            ntv2 = _tree_where(valid, ntv2, ntv)
+            opt2 = _tree_where(valid, opt2, opt_state)
+
+            acc_sum = (
+                jnp.sum(acc_fn(y, y_pred) * sw) if acc_fn is not None else jnp.zeros(())
+            )
+            stats = (jnp.where(valid, loss * wsum, 0.0), acc_sum, wsum)
+            return tv2, ntv2, opt2, stats
+
+        return train_step
+
+    def build_eval_step(self) -> Callable:
+        """``(tv, ntv, x, y, sw) → (loss_wsum, acc_wsum, wsum)``."""
+        model = self.model
+        per_sample_loss = resolve_per_sample_loss(self.loss_spec)
+        acc_fn = resolve_accuracy(self.loss_spec) if self.wants_accuracy else None
+
+        def eval_step(tv, ntv, x, y, sw):
+            y_pred, _ = model.stateless_call(tv, ntv, x, training=False)
+            per = per_sample_loss(y, y_pred)
+            wsum = jnp.sum(sw)
+            acc_sum = (
+                jnp.sum(acc_fn(y, y_pred) * sw) if acc_fn is not None else jnp.zeros(())
+            )
+            return jnp.sum(per * sw), acc_sum, wsum
+
+        return eval_step
+
+    def build_predict_fn(self) -> Callable:
+        model = self.model
+
+        def predict_fn(tv, ntv, x):
+            y_pred, _ = model.stateless_call(tv, ntv, x, training=False)
+            return y_pred
+
+        return predict_fn

@@ -1,0 +1,189 @@
+"""Keras loss spec → per-sample JAX loss function.
+
+The on-device engine (``elephas_tpu/parallel/engine.py``) needs *per-sample*
+losses so that padded samples (partition sizes rarely divide the batch size)
+can be masked with zero sample-weights without changing gradient scale — the
+weighted-mean reduction ``sum(l_i * w_i) / sum(w_i)`` then reproduces what the
+reference's ``model.fit`` computes on the real, unpadded batch.
+
+The reference never implements losses itself — it forwards compile strings to
+Keras (``elephas/spark_model.py:~30`` records ``master_loss``). Here the
+common Keras loss names are implemented directly in jax.numpy (traceable,
+fusable by XLA); unknown losses fall back to calling the Keras loss object,
+which is traceable under the JAX backend but reduces with Keras semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-7
+
+
+def _mse(y_true, y_pred):
+    return jnp.mean(jnp.square(y_pred - y_true), axis=tuple(range(1, y_pred.ndim)))
+
+
+def _mae(y_true, y_pred):
+    return jnp.mean(jnp.abs(y_pred - y_true), axis=tuple(range(1, y_pred.ndim)))
+
+
+def _binary_crossentropy(from_logits: bool):
+    def fn(y_true, y_pred):
+        y_true = y_true.reshape(y_pred.shape).astype(y_pred.dtype)
+        if from_logits:
+            # log-sum-exp stable form: max(x,0) - x*z + log(1+exp(-|x|))
+            x = y_pred
+            per = jnp.maximum(x, 0) - x * y_true + jnp.log1p(jnp.exp(-jnp.abs(x)))
+        else:
+            p = jnp.clip(y_pred, _EPS, 1.0 - _EPS)
+            per = -(y_true * jnp.log(p) + (1.0 - y_true) * jnp.log(1.0 - p))
+        return jnp.mean(per, axis=tuple(range(1, per.ndim)))
+
+    return fn
+
+
+def _categorical_crossentropy(from_logits: bool):
+    def fn(y_true, y_pred):
+        y_true = y_true.astype(y_pred.dtype)
+        if from_logits:
+            logp = jax.nn.log_softmax(y_pred, axis=-1)
+        else:
+            logp = jnp.log(jnp.clip(y_pred, _EPS, 1.0))
+        per = -jnp.sum(y_true * logp, axis=-1)
+        return jnp.mean(per, axis=tuple(range(1, per.ndim)))
+
+    return fn
+
+
+def _sparse_categorical_crossentropy(from_logits: bool):
+    def fn(y_true, y_pred):
+        labels = y_true.reshape(y_pred.shape[:-1]).astype(jnp.int32)
+        if from_logits:
+            logp = jax.nn.log_softmax(y_pred, axis=-1)
+        else:
+            logp = jnp.log(jnp.clip(y_pred, _EPS, 1.0))
+        per = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        return jnp.mean(per, axis=tuple(range(1, per.ndim)))
+
+    return fn
+
+
+def _hinge(y_true, y_pred):
+    # Keras hinge maps {0,1} labels to {-1,1}.
+    y = jnp.where(y_true <= 0, -1.0, 1.0).astype(y_pred.dtype)
+    per = jnp.maximum(1.0 - y * y_pred, 0.0)
+    return jnp.mean(per, axis=tuple(range(1, per.ndim)))
+
+
+def _huber(delta: float = 1.0):
+    def fn(y_true, y_pred):
+        err = y_pred - y_true.astype(y_pred.dtype)
+        abs_err = jnp.abs(err)
+        quad = jnp.minimum(abs_err, delta)
+        per = 0.5 * quad * quad + delta * (abs_err - quad)
+        return jnp.mean(per, axis=tuple(range(1, per.ndim)))
+
+    return fn
+
+
+_ALIASES = {
+    "mse": "mean_squared_error",
+    "mae": "mean_absolute_error",
+    "bce": "binary_crossentropy",
+}
+
+
+def _loss_name_and_config(loss_spec) -> Tuple[str, dict]:
+    """Normalize a loss spec (string / Keras Loss / callable) to (name, cfg)."""
+    if isinstance(loss_spec, str):
+        name = loss_spec.lower()
+        return _ALIASES.get(name, name), {}
+    cfg = {}
+    if hasattr(loss_spec, "get_config"):
+        try:
+            cfg = loss_spec.get_config() or {}
+        except Exception:
+            cfg = {}
+    name = getattr(loss_spec, "name", None) or getattr(loss_spec, "__name__", "")
+    name = str(name).lower()
+    return _ALIASES.get(name, name), cfg
+
+
+def resolve_per_sample_loss(loss_spec) -> Callable:
+    """Return ``fn(y_true, y_pred) -> [batch]`` per-sample losses.
+
+    Accepts the same specs Keras ``compile(loss=...)`` does.
+    """
+    name, cfg = _loss_name_and_config(loss_spec)
+    from_logits = bool(cfg.get("from_logits", False))
+
+    if name in ("mean_squared_error",):
+        return _mse
+    if name in ("mean_absolute_error",):
+        return _mae
+    if name == "binary_crossentropy":
+        return _binary_crossentropy(from_logits)
+    if name == "categorical_crossentropy":
+        return _categorical_crossentropy(from_logits)
+    if name == "sparse_categorical_crossentropy":
+        return _sparse_categorical_crossentropy(from_logits)
+    if name == "hinge":
+        return _hinge
+    if name in ("huber", "huber_loss"):
+        return _huber(float(cfg.get("delta", 1.0)))
+
+    # Fallback: resolve through Keras. Keras Loss objects reduce to a scalar;
+    # broadcast that scalar to per-sample shape so masking still works
+    # approximately (exact when no padding is present).
+    import keras
+
+    loss_obj = keras.losses.get(loss_spec)
+
+    def fallback(y_true, y_pred):
+        val = loss_obj(y_true, y_pred)
+        val = jnp.asarray(val)
+        if val.ndim == 0:
+            return jnp.broadcast_to(val, (y_pred.shape[0],))
+        return val.reshape((y_pred.shape[0], -1)).mean(axis=-1)
+
+    return fallback
+
+
+# -- metrics -----------------------------------------------------------------
+
+
+def resolve_accuracy(loss_spec) -> Callable:
+    """Per-sample accuracy matched to the loss family (Keras 'accuracy' magic).
+
+    Keras resolves the bare string ``'accuracy'`` against the loss/output
+    shape; mirror the three common cases.
+    """
+    name, _ = _loss_name_and_config(loss_spec)
+
+    if name == "sparse_categorical_crossentropy":
+
+        def acc(y_true, y_pred):
+            labels = y_true.reshape(y_pred.shape[:-1]).astype(jnp.int32)
+            return (jnp.argmax(y_pred, axis=-1) == labels).astype(jnp.float32)
+
+        return acc
+    if name == "binary_crossentropy":
+
+        def acc(y_true, y_pred):
+            yt = y_true.reshape(y_pred.shape)
+            pred = (y_pred > 0.5).astype(y_pred.dtype)
+            per = (pred == yt.astype(y_pred.dtype)).astype(jnp.float32)
+            return per.reshape((per.shape[0], -1)).mean(axis=-1)
+
+        return acc
+
+    def acc(y_true, y_pred):  # categorical / default
+        return (
+            jnp.argmax(y_pred, axis=-1) == jnp.argmax(y_true, axis=-1)
+        ).astype(jnp.float32).reshape((y_pred.shape[0], -1)).mean(axis=-1)
+
+    return acc

@@ -1,0 +1,115 @@
+"""Keras optimizer spec → optax ``GradientTransformation``.
+
+The reference records the compiled optimizer as ``master_optimizer``
+(``elephas/spark_model.py:~30``) and hands it to Keras inside each worker. The
+on-device engine instead runs a functional optax optimizer inside the compiled
+step (optimizer state lives on-chip, sharded with the worker). This module
+maps Keras optimizer identities/configs onto optax equivalents with matching
+hyperparameters and update rules.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any, Tuple
+
+import optax
+
+
+def _extract_lr(cfg: dict) -> float:
+    lr = cfg.get("learning_rate", cfg.get("lr", 0.001))
+    if isinstance(lr, dict):
+        # Serialized Keras LearningRateSchedule — use its initial rate.
+        inner = lr.get("config", {})
+        lr = inner.get("initial_learning_rate", 0.001)
+    return float(lr)
+
+
+def _normalize(optimizer_spec) -> Tuple[str, dict]:
+    """Spec (string / Keras optimizer / config dict) → (name, config)."""
+    if isinstance(optimizer_spec, str):
+        return optimizer_spec.lower(), {}
+    if isinstance(optimizer_spec, dict):
+        # Either a raw get_config() dict or keras.optimizers.serialize output.
+        if "class_name" in optimizer_spec:
+            return (
+                optimizer_spec["class_name"].lower(),
+                dict(optimizer_spec.get("config", {})),
+            )
+        return optimizer_spec.get("name", "sgd").lower(), dict(optimizer_spec)
+    if hasattr(optimizer_spec, "get_config"):
+        cfg = optimizer_spec.get_config()
+        name = cfg.get("name", type(optimizer_spec).__name__).lower()
+        return name, cfg
+    raise TypeError(f"Cannot interpret optimizer spec: {optimizer_spec!r}")
+
+
+def to_optax(optimizer_spec: Any) -> optax.GradientTransformation:
+    """Build the optax transformation matching a Keras optimizer spec."""
+    name, cfg = _normalize(optimizer_spec)
+    lr = _extract_lr(cfg)
+
+    if name == "sgd":
+        momentum = float(cfg.get("momentum", 0.0) or 0.0)
+        nesterov = bool(cfg.get("nesterov", False))
+        return optax.sgd(lr, momentum=momentum or None, nesterov=nesterov)
+    if name == "adam":
+        return optax.adam(
+            lr,
+            b1=float(cfg.get("beta_1", 0.9)),
+            b2=float(cfg.get("beta_2", 0.999)),
+            eps=float(cfg.get("epsilon", 1e-7)),
+        )
+    if name == "adamw":
+        return optax.adamw(
+            lr,
+            b1=float(cfg.get("beta_1", 0.9)),
+            b2=float(cfg.get("beta_2", 0.999)),
+            eps=float(cfg.get("epsilon", 1e-7)),
+            weight_decay=float(cfg.get("weight_decay", 0.004) or 0.0),
+        )
+    if name == "rmsprop":
+        return optax.rmsprop(
+            lr,
+            decay=float(cfg.get("rho", 0.9)),
+            eps=float(cfg.get("epsilon", 1e-7)),
+            momentum=float(cfg.get("momentum", 0.0) or 0.0),
+            centered=bool(cfg.get("centered", False)),
+        )
+    if name == "adagrad":
+        return optax.adagrad(
+            lr,
+            initial_accumulator_value=float(cfg.get("initial_accumulator_value", 0.1)),
+            eps=float(cfg.get("epsilon", 1e-7)),
+        )
+    if name == "adadelta":
+        return optax.adadelta(
+            lr,
+            rho=float(cfg.get("rho", 0.95)),
+            eps=float(cfg.get("epsilon", 1e-7)),
+        )
+    if name == "adamax":
+        return optax.adamax(
+            lr,
+            b1=float(cfg.get("beta_1", 0.9)),
+            b2=float(cfg.get("beta_2", 0.999)),
+            eps=float(cfg.get("epsilon", 1e-7)),
+        )
+    if name == "nadam":
+        return optax.nadam(
+            lr,
+            b1=float(cfg.get("beta_1", 0.9)),
+            b2=float(cfg.get("beta_2", 0.999)),
+            eps=float(cfg.get("epsilon", 1e-7)),
+        )
+    if name == "lion":
+        return optax.lion(
+            lr,
+            b1=float(cfg.get("beta_1", 0.9)),
+            b2=float(cfg.get("beta_2", 0.99)),
+        )
+
+    warnings.warn(
+        f"Optimizer '{name}' has no optax mapping; falling back to SGD(lr={lr})."
+    )
+    return optax.sgd(lr)

@@ -1,0 +1,1 @@
+"""Custom TPU ops (Pallas kernels) — populated as hot ops are identified."""

@@ -1,0 +1,4 @@
+from .engine import CompiledTrainer, FitResult
+from .mesh import DATA_AXIS, build_mesh
+
+__all__ = ["CompiledTrainer", "FitResult", "build_mesh", "DATA_AXIS"]

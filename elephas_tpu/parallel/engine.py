@@ -1,0 +1,424 @@
+"""The compiled data-parallel training engine.
+
+This is the TPU-native replacement for the reference's entire L2–L3 stack
+(parameter server + workers, ``elephas/parameter/server.py``,
+``elephas/worker.py``; SURVEY.md §2.3/§2.4): instead of executors pickling
+weight deltas over HTTP/TCP to a driver-hosted server, every elephas training
+mode becomes ONE jitted XLA program, ``shard_map``-ed over a 1-D ``"data"``
+mesh, in which per-worker model replicas train locally (``lax.scan`` over
+shuffled batches) and merge through ``psum`` collectives riding ICI. Weights
+never leave the chips; the host only stages input data and reads back final
+parameters + metric histories.
+
+Mode → schedule mapping (exact semantics in MERGE SEMANTICS below):
+
+- ``synchronous``  — train ``epochs`` locally, ONE merge at the end.
+  This is bit-faithful to the reference sync path: each worker computes
+  ``delta = w0 - w_final`` and the driver applies the (averaged) deltas
+  (``elephas/spark_model.py:~150``).
+- ``asynchronous`` / ``hogwild``, ``frequency='epoch'`` — merge after every
+  local epoch (the on-device analog of per-epoch pull/push against the
+  parameter server, ``elephas/worker.py:~70``).
+- ``asynchronous`` / ``hogwild``, ``frequency='batch'`` — merge after every
+  batch (the analog of per-batch pull/push).
+
+MERGE SEMANTICS. The reference's parameter server applies every pushed delta
+in full (``weights -= delta``, ``parameter/server.py:~40``), so one "round" of
+W workers moves the server by the SUM of deltas; the fork's synchronous path
+averages instead (``divide_by(num_workers)``). Both are provided:
+``merge='sum'`` (server/upstream-faithful, default for async modes) and
+``merge='mean'`` (fork-sync-faithful, default for synchronous). True unordered
+asynchrony cannot exist inside a lockstep XLA program; staleness collapses to
+"one merge period", which is the documented fidelity envelope (SURVEY.md
+§7.3.1) — the wire-level parameter server in ``elephas_tpu/parameter/``
+remains available when literal asynchrony is wanted.
+
+Padding. Partitions rarely divide the batch size, and worker count rarely
+divides device count; both are padded (samples with zero sample-weight,
+workers with a zero valid-flag) and masked out of losses, optimizer updates,
+and merge denominators, so results match the unpadded math the reference
+computes.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.adapters import KerasModelAdapter
+from .mesh import DATA_AXIS, build_mesh
+
+Array = Any
+
+
+def _pad_block(arr: np.ndarray, target_rows: int) -> np.ndarray:
+    """Zero-pad ``arr`` along axis 0 to ``target_rows``."""
+    n = arr.shape[0]
+    if n == target_rows:
+        return arr
+    pad = np.zeros((target_rows - n,) + arr.shape[1:], dtype=arr.dtype)
+    return np.concatenate([arr, pad], axis=0)
+
+
+class FitResult:
+    """Final weights + Keras-``History``-shaped metrics."""
+
+    def __init__(self, weights: List[np.ndarray], history: Dict[str, List[float]]):
+        self.weights = weights
+        self.history = history
+
+
+class CompiledTrainer:
+    """Compile-and-run elephas training modes on a device mesh.
+
+    One instance per (adapter, mesh); compiled executables are cached by the
+    static schedule/shape signature, so repeated ``fit`` calls with the same
+    geometry reuse the XLA program.
+    """
+
+    def __init__(self, adapter: KerasModelAdapter, mesh: Optional[Mesh] = None,
+                 mode: str = "synchronous", frequency: str = "epoch",
+                 merge: str = "auto"):
+        if mode not in ("synchronous", "asynchronous", "hogwild"):
+            raise ValueError(f"Unknown mode: {mode}")
+        if frequency not in ("epoch", "batch"):
+            raise ValueError(f"Unknown frequency: {frequency}")
+        self.adapter = adapter
+        self.mesh = mesh if mesh is not None else build_mesh()
+        self.mode = mode
+        self.frequency = frequency
+        if merge == "auto":
+            merge = "mean" if mode == "synchronous" else "sum"
+        if merge not in ("mean", "sum"):
+            raise ValueError(f"Unknown merge: {merge}")
+        self.merge = merge
+        self.optimizer = adapter.make_optimizer()
+        self._cache: Dict[tuple, Any] = {}
+
+    # ------------------------------------------------------------------
+    def fit(self, blocks: Sequence[Tuple[np.ndarray, np.ndarray]], epochs: int,
+            batch_size: int, validation_split: float = 0.0,
+            seed: int = 0, verbose: int = 0) -> FitResult:
+        """Train over per-worker data ``blocks`` ``[(x_w, y_w), ...]``.
+
+        Returns merged weights in ``get_weights()`` order plus per-epoch
+        history (``loss``[, ``accuracy``, ``val_loss``, ``val_accuracy``]).
+        """
+        W = len(blocks)
+        if W == 0:
+            raise ValueError("No worker data blocks (all partitions skipped?)")
+        D = self.mesh.devices.size
+        Wp = int(math.ceil(W / D) * D)
+        L = Wp // D
+        B = int(batch_size)
+        E = int(epochs)
+
+        # -- split train/val per worker (Keras semantics: validation data is
+        # the LAST fraction of each worker's block, taken before shuffling —
+        # reference workers call model.fit(validation_split=...)).
+        xs, ys, sws, xvs, yvs, svs = [], [], [], [], [], []
+        n_trains, n_vals = [], []
+        for x_w, y_w in blocks:
+            x_w = np.asarray(x_w)
+            y_w = np.asarray(y_w)
+            n = x_w.shape[0]
+            n_val = int(n * validation_split) if validation_split else 0
+            n_trains.append(n - n_val)
+            n_vals.append(n_val)
+        S = max(1, max(int(math.ceil(nt / B)) for nt in n_trains))
+        N = S * B
+        has_val = any(nv > 0 for nv in n_vals)
+        Sv = max(1, max(int(math.ceil(nv / B)) for nv in n_vals)) if has_val else 1
+        Nv = Sv * B
+
+        for (x_w, y_w), nt, nv in zip(blocks, n_trains, n_vals):
+            x_w = np.asarray(x_w)
+            y_w = np.asarray(y_w)
+            xs.append(_pad_block(x_w[:nt], N))
+            ys.append(_pad_block(y_w[:nt], N))
+            sws.append(_pad_block(np.ones((nt,), np.float32), N))
+            if has_val:
+                xvs.append(_pad_block(x_w[nt:], Nv))
+                yvs.append(_pad_block(y_w[nt:], Nv))
+                svs.append(_pad_block(np.ones((nv,), np.float32), Nv))
+
+        # -- pad to Wp workers (invalid: zero weights everywhere)
+        def stack_pad(parts, row_shape_src):
+            while len(parts) < Wp:
+                parts.append(np.zeros_like(row_shape_src))
+            return np.stack(parts, axis=0)
+
+        x = stack_pad(xs, xs[0])
+        y = stack_pad(ys, ys[0])
+        sw = stack_pad(sws, np.zeros_like(sws[0]))
+        if has_val:
+            xv = stack_pad(xvs, xvs[0])
+            yv = stack_pad(yvs, yvs[0])
+            sv = stack_pad(svs, np.zeros_like(svs[0]))
+        else:
+            xv = yv = sv = np.zeros((Wp, 1), np.float32)
+        wvalid = np.array([1.0] * W + [0.0] * (Wp - W), np.float32)
+        keys = jax.random.split(jax.random.PRNGKey(seed), Wp)
+
+        tv0, ntv0 = self.adapter.state_values()
+        mergeable = [slot is not None for slot in self.adapter._ntv_slots]
+
+        sig = (
+            Wp, N, S, B, E, Sv, has_val, self.mode, self.frequency, self.merge,
+            tuple(x.shape), tuple(y.shape), str(x.dtype), str(y.dtype),
+        )
+        if sig not in self._cache:
+            self._cache[sig] = self._build(
+                L=L, S=S, B=B, E=E, Sv=Sv, has_val=has_val, mergeable=mergeable
+            )
+        fit_fn = self._cache[sig]
+
+        tv_out, ntv_out, metrics = fit_fn(
+            tv0, ntv0, x, y, sw, xv, yv, sv, keys, wvalid
+        )
+
+        # -- install merged state back into the live model
+        tv_out = [np.asarray(t) for t in tv_out]
+        ntv_full = []
+        ntv_out = list(ntv_out)
+        for is_m, cur in zip(mergeable, ntv0):
+            ntv_full.append(np.asarray(ntv_out.pop(0)) if is_m else np.asarray(cur))
+        self.adapter.install_state(tv_out, ntv_full)
+
+        history: Dict[str, List[float]] = {"loss": [float(v) for v in metrics["loss"]]}
+        if self.adapter.wants_accuracy:
+            history["accuracy"] = [float(v) for v in metrics["accuracy"]]
+        if has_val:
+            history["val_loss"] = [float(v) for v in metrics["val_loss"]]
+            if self.adapter.wants_accuracy:
+                history["val_accuracy"] = [float(v) for v in metrics["val_accuracy"]]
+        if verbose:
+            for e in range(E):
+                line = f"epoch {e + 1}/{E} - loss: {history['loss'][e]:.4f}"
+                if "val_loss" in history:
+                    line += f" - val_loss: {history['val_loss'][e]:.4f}"
+                print(line)
+        return FitResult(self.adapter.get_weights(), history)
+
+    # ------------------------------------------------------------------
+    def _build(self, L: int, S: int, B: int, E: int, Sv: int, has_val: bool,
+               mergeable: List[bool]):
+        """Trace+compile the full multi-epoch training program."""
+        adapter = self.adapter
+        optimizer = self.optimizer
+        train_step = adapter.build_train_step(optimizer)
+        eval_step = adapter.build_eval_step()
+        merge_kind = self.merge
+        merge_every_epoch = self.mode in ("asynchronous", "hogwild") and (
+            self.frequency == "epoch"
+        )
+        merge_every_batch = self.mode in ("asynchronous", "hogwild") and (
+            self.frequency == "batch"
+        )
+
+        def _bsum(tree_stack, wvalid):
+            """Σ_l valid_l * leaf_l over the local worker dim."""
+            def leaf(a):
+                wshape = (-1,) + (1,) * (a.ndim - 1)
+                return jnp.sum(a * wvalid.reshape(wshape).astype(a.dtype), axis=0)
+            return jax.tree_util.tree_map(leaf, tree_stack)
+
+        def merge_tv(tv_stack, base_tv, wvalid, denom):
+            """Apply summed/averaged worker deltas to the base params."""
+            local = _bsum(
+                jax.tree_util.tree_map(lambda s, b: b[None] - s, tv_stack, base_tv),
+                wvalid,
+            )
+            total = jax.lax.psum(local, DATA_AXIS)
+            if merge_kind == "mean":
+                total = jax.tree_util.tree_map(lambda t: t / denom, total)
+            return jax.tree_util.tree_map(lambda b, t: b - t, base_tv, total)
+
+        def merge_ntv(ntv_stack, base_ntv, wvalid, denom):
+            """Merge only weight-slot ntv entries (BN stats); seed/counter
+            state stays per-worker."""
+            out = []
+            for i, is_m in enumerate(mergeable):
+                if not is_m:
+                    out.append(ntv_stack[i])
+                    continue
+                s, b = ntv_stack[i], base_ntv[i]
+                delta = b[None] - s
+                loc = jnp.sum(
+                    delta
+                    * wvalid.reshape((-1,) + (1,) * (delta.ndim - 1)).astype(delta.dtype),
+                    axis=0,
+                )
+                tot = jax.lax.psum(loc, DATA_AXIS)
+                if merge_kind == "mean":
+                    tot = tot / denom
+                merged = b - tot
+                out.append(jnp.broadcast_to(merged[None], s.shape).astype(s.dtype))
+            return out
+
+        def shuffled_batches(x_l, y_l, sw_l, key):
+            perm = jax.random.permutation(key, x_l.shape[0])
+            xb = x_l[perm].reshape((S, B) + x_l.shape[1:])
+            yb = y_l[perm].reshape((S, B) + y_l.shape[1:])
+            swb = sw_l[perm].reshape((S, B))
+            return xb, yb, swb
+
+        def local_epoch(tv, ntv, opt, x_l, y_l, sw_l, key):
+            xb, yb, swb = shuffled_batches(x_l, y_l, sw_l, key)
+
+            def step(carry, batch):
+                tv, ntv, opt = carry
+                tv, ntv, opt, stats = train_step(tv, ntv, opt, *batch)
+                return (tv, ntv, opt), stats
+
+            (tv, ntv, opt), stats = jax.lax.scan(step, (tv, ntv, opt), (xb, yb, swb))
+            return tv, ntv, opt, jax.tree_util.tree_map(jnp.sum, stats)
+
+        def local_eval(tv, ntv, xv_l, yv_l, sv_l):
+            xb = xv_l.reshape((Sv, B) + xv_l.shape[1:])
+            yb = yv_l.reshape((Sv, B) + yv_l.shape[1:])
+            svb = sv_l.reshape((Sv, B))
+
+            def step(_, batch):
+                return None, eval_step(tv, ntv, *batch)
+
+            _, stats = jax.lax.scan(step, None, (xb, yb, svb))
+            return jax.tree_util.tree_map(jnp.sum, stats)
+
+        def fit_impl(tv0, ntv0, x, y, sw, xv, yv, sv, keys, wvalid):
+            # Local shapes inside the shard: x [L, N, ...], keys [L, 2],
+            # wvalid [L]; tv0/ntv0 replicated.
+            denom = jnp.maximum(jax.lax.psum(jnp.sum(wvalid), DATA_AXIS), 1.0)
+            tile = lambda t: jnp.broadcast_to(t[None], (L,) + t.shape).astype(t.dtype)
+            tv_stack = jax.tree_util.tree_map(tile, tv0)
+            # Non-mergeable integer ntv entries are seed-generator state:
+            # offset each replica by its global worker id so dropout masks are
+            # independent across workers (as the reference's independent
+            # executors are), not identical copies.
+            widx = jax.lax.axis_index(DATA_AXIS) * L + jnp.arange(L)
+            ntv_stack = []
+            for t, is_m in zip(ntv0, mergeable):
+                tiled = tile(t)
+                if not is_m and jnp.issubdtype(jnp.asarray(t).dtype, jnp.integer):
+                    tiled = tiled + widx.reshape((L,) + (1,) * jnp.asarray(t).ndim).astype(tiled.dtype)
+                ntv_stack.append(tiled)
+            opt_stack = jax.vmap(optimizer.init)(tv_stack)
+            base_tv, base_ntv = tv0, list(ntv0)
+
+            def epoch_body(carry, e):
+                tv_stack, ntv_stack, opt_stack, base_tv, base_ntv = carry
+                ekeys = jax.vmap(lambda k: jax.random.fold_in(k, e))(keys)
+
+                if merge_every_batch:
+                    # Pull/train-one-batch/push per step, merged outside vmap.
+                    xb, yb, swb = jax.vmap(shuffled_batches)(x, y, sw, ekeys)
+                    # [L, S, B, ...] → scan over S
+                    xb = jnp.swapaxes(xb, 0, 1)
+                    yb = jnp.swapaxes(yb, 0, 1)
+                    swb = jnp.swapaxes(swb, 0, 1)
+
+                    def bstep(carry, batch):
+                        tv_stack, ntv_stack, opt_stack, base_tv, base_ntv = carry
+                        tv_stack, ntv_stack, opt_stack, stats = jax.vmap(
+                            train_step
+                        )(tv_stack, ntv_stack, opt_stack, *batch)
+                        new_base_tv = merge_tv(tv_stack, base_tv, wvalid, denom)
+                        new_base_ntv_full = merge_ntv(
+                            ntv_stack, base_ntv, wvalid, denom
+                        )
+                        # v[0]: mergeable entries are replicated stacks (any
+                        # row is the merged value); non-mergeable base is
+                        # unused by merges — keep worker 0's, dtype intact.
+                        new_base_ntv = [v[0] for v in new_base_ntv_full]
+                        tv_stack = jax.tree_util.tree_map(tile, new_base_tv)
+                        ntv_stack = [
+                            jnp.broadcast_to(b[None], s.shape).astype(s.dtype)
+                            if m else s
+                            for b, s, m in zip(
+                                new_base_ntv, ntv_stack, mergeable
+                            )
+                        ]
+                        return (
+                            tv_stack, ntv_stack, opt_stack, new_base_tv,
+                            new_base_ntv,
+                        ), stats
+
+                    (tv_stack, ntv_stack, opt_stack, base_tv, base_ntv), stats = (
+                        jax.lax.scan(
+                            bstep,
+                            (tv_stack, ntv_stack, opt_stack, base_tv, base_ntv),
+                            (xb, yb, swb),
+                        )
+                    )
+                    stats = jax.tree_util.tree_map(lambda a: jnp.sum(a, axis=0), stats)
+                else:
+                    tv_stack, ntv_stack, opt_stack, stats = jax.vmap(local_epoch)(
+                        tv_stack, ntv_stack, opt_stack, x, y, sw, ekeys
+                    )
+                    if merge_every_epoch:
+                        base_tv = merge_tv(tv_stack, base_tv, wvalid, denom)
+                        merged_full = merge_ntv(ntv_stack, base_ntv, wvalid, denom)
+                        base_ntv = [v[0] for v in merged_full]
+                        tv_stack = jax.tree_util.tree_map(tile, base_tv)
+                        ntv_stack = [
+                            v if m else s
+                            for v, s, m in zip(merged_full, ntv_stack, mergeable)
+                        ]
+
+                # -- epoch metrics (weighted sums → psum → global means)
+                loss_ws, acc_ws, wsum = stats
+                loss_sum = jax.lax.psum(jnp.sum(loss_ws), DATA_AXIS)
+                acc_sum = jax.lax.psum(jnp.sum(acc_ws), DATA_AXIS)
+                w_sum = jnp.maximum(jax.lax.psum(jnp.sum(wsum), DATA_AXIS), 1e-9)
+                metrics = {
+                    "loss": loss_sum / w_sum,
+                    "accuracy": acc_sum / w_sum,
+                }
+                if has_val:
+                    vstats = jax.vmap(
+                        lambda tv, ntv, a, b, c: local_eval(tv, ntv, a, b, c)
+                    )(tv_stack, ntv_stack, xv, yv, sv)
+                    vloss = jax.lax.psum(jnp.sum(vstats[0]), DATA_AXIS)
+                    vacc = jax.lax.psum(jnp.sum(vstats[1]), DATA_AXIS)
+                    vw = jnp.maximum(jax.lax.psum(jnp.sum(vstats[2]), DATA_AXIS), 1e-9)
+                    metrics["val_loss"] = vloss / vw
+                    metrics["val_accuracy"] = vacc / vw
+
+                return (tv_stack, ntv_stack, opt_stack, base_tv, base_ntv), metrics
+
+            (tv_stack, ntv_stack, opt_stack, base_tv, base_ntv), metrics = (
+                jax.lax.scan(
+                    epoch_body,
+                    (tv_stack, ntv_stack, opt_stack, base_tv, base_ntv),
+                    jnp.arange(E),
+                )
+            )
+
+            if not (merge_every_epoch or merge_every_batch):
+                # synchronous: the single end-of-fit merge
+                base_tv = merge_tv(tv_stack, base_tv, wvalid, denom)
+                merged_full = merge_ntv(ntv_stack, base_ntv, wvalid, denom)
+                base_ntv = [v[0] for v in merged_full]
+
+            ntv_mergeable_out = [v for v, m in zip(base_ntv, mergeable) if m]
+            return base_tv, ntv_mergeable_out, metrics
+
+        mesh = self.mesh
+        pspec_rep = P()
+        pspec_data = P(DATA_AXIS)
+        shard_fit = jax.shard_map(
+            fit_impl,
+            mesh=mesh,
+            in_specs=(
+                pspec_rep, pspec_rep, pspec_data, pspec_data, pspec_data,
+                pspec_data, pspec_data, pspec_data, pspec_data, pspec_data,
+            ),
+            out_specs=(pspec_rep, pspec_rep, pspec_rep),
+            check_vma=False,
+        )
+        return jax.jit(shard_fit)

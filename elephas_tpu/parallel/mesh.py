@@ -1,0 +1,53 @@
+"""Device-mesh helpers.
+
+The reference's "cluster" is a set of Spark executors; the TPU-native
+equivalent is a 1-D ``jax.sharding.Mesh`` over the local (or distributed)
+device set with a single ``"data"`` axis — elephas is data-parallel only
+(SURVEY.md §2.3), so one axis carries every mode. Multi-host pods join the
+same mesh after ``jax.distributed.initialize`` (the ``determine_master``
+analog — see ``elephas_tpu/utils/sockets.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "data"
+
+
+def build_mesh(num_devices: Optional[int] = None,
+               devices: Optional[Sequence] = None,
+               axis_name: str = DATA_AXIS) -> Mesh:
+    """A 1-D data-parallel mesh over ``num_devices`` (default: all local)."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if num_devices is not None:
+        if num_devices > len(devs):
+            raise ValueError(
+                f"Requested {num_devices} devices but only {len(devs)} available"
+            )
+        devs = devs[:num_devices]
+    return Mesh(np.array(devs), (axis_name,))
+
+
+def replicated_spec() -> PartitionSpec:
+    return PartitionSpec()
+
+
+def data_spec() -> PartitionSpec:
+    return PartitionSpec(DATA_AXIS)
+
+
+def shard_leading(mesh: Mesh, array):
+    """Put ``array`` on ``mesh`` sharded along its leading axis."""
+    return jax.device_put(array, NamedSharding(mesh, PartitionSpec(DATA_AXIS)))
+
+
+def replicate(mesh: Mesh, tree):
+    """Replicate a pytree across the mesh."""
+    sharding = NamedSharding(mesh, PartitionSpec())
+    return jax.tree_util.tree_map(lambda a: jax.device_put(a, sharding), tree)

@@ -1,0 +1,11 @@
+from .client import BaseParameterClient, HttpClient, SocketClient
+from .server import BaseParameterServer, HttpServer, SocketServer
+
+__all__ = [
+    "BaseParameterClient",
+    "HttpClient",
+    "SocketClient",
+    "BaseParameterServer",
+    "HttpServer",
+    "SocketServer",
+]

@@ -1,0 +1,109 @@
+"""Parameter-server clients.
+
+Rebuild of reference ``elephas/parameter/client.py:~1``:
+``BaseParameterClient.get_client`` factory, ``HttpClient`` (urllib + pickle
+against ``GET /parameters`` / ``POST /update``) and ``SocketClient`` (raw TCP,
+``'g'``/``'u'`` opcodes). Wire format matches
+:mod:`elephas_tpu.parameter.server`.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import threading
+import urllib.request
+from typing import List, Optional
+
+import numpy as np
+
+from ..utils import sockets as socket_utils
+from ..utils.sockets import determine_master
+
+
+class BaseParameterClient:
+    @staticmethod
+    def get_client(client_mode: str = "http", port: int = 4000,
+                   host: Optional[str] = None) -> "BaseParameterClient":
+        """Factory mirroring the reference's client selection
+        (``parameter/client.py:~15``)."""
+        if client_mode == "http":
+            return HttpClient(port=port, host=host)
+        if client_mode == "socket":
+            return SocketClient(port=port, host=host)
+        raise ValueError(f"Unknown parameter server mode: {client_mode}")
+
+    def get_parameters(self) -> List[np.ndarray]:
+        raise NotImplementedError
+
+    def update_parameters(self, delta: List[np.ndarray]) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class HttpClient(BaseParameterClient):
+    """Pull/push pickled weight lists over HTTP."""
+
+    def __init__(self, port: int = 4000, host: Optional[str] = None):
+        if host is None:
+            self.master_url = determine_master(port)
+        else:
+            self.master_url = f"{host}:{port}"
+
+    def get_parameters(self) -> List[np.ndarray]:
+        with urllib.request.urlopen(
+            f"http://{self.master_url}/parameters", timeout=60
+        ) as resp:
+            return pickle.loads(resp.read())
+
+    def update_parameters(self, delta: List[np.ndarray]) -> None:
+        payload = pickle.dumps(delta, protocol=pickle.HIGHEST_PROTOCOL)
+        req = urllib.request.Request(
+            f"http://{self.master_url}/update",
+            data=payload,
+            headers={"Content-Type": "application/octet-stream"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            resp.read()
+
+
+class SocketClient(BaseParameterClient):
+    """Persistent-connection TCP client (one connection per client instance).
+
+    Thread-safe: pull/push pairs are serialized per client with a lock so the
+    opcode stream cannot interleave across threads sharing a client.
+    """
+
+    def __init__(self, port: int = 4000, host: Optional[str] = None):
+        if host is None:
+            host = determine_master(port).rsplit(":", 1)[0]
+        self.host = host
+        self.port = int(port)
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _ensure(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection((self.host, self.port), timeout=60)
+        return self._sock
+
+    def get_parameters(self) -> List[np.ndarray]:
+        with self._lock:
+            sock = self._ensure()
+            sock.sendall(b"g")
+            return socket_utils.receive(sock)
+
+    def update_parameters(self, delta: List[np.ndarray]) -> None:
+        with self._lock:
+            sock = self._ensure()
+            sock.sendall(b"u")
+            socket_utils.send(sock, delta)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None

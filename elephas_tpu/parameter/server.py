@@ -1,0 +1,202 @@
+"""Parameter servers: HTTP and raw-TCP, wire-compatible with the reference.
+
+Rebuild of reference ``elephas/parameter/server.py:~1`` (``BaseParameterServer``,
+``HttpServer`` — Flask ``GET /parameters`` / ``POST /update`` under a
+``threading.Lock`` skipped for hogwild — and ``SocketServer`` — raw TCP with
+``'g'``/``'u'`` opcodes and per-connection threads).
+
+On TPU these servers are the *compatibility* communication path: the fast path
+merges weights on-device via XLA collectives (``elephas_tpu/parallel/engine.py``)
+and never touches a server. The host servers remain for (a) behavioral parity
+with the reference's asynchronous/hogwild semantics, including genuine
+interleaving races, and (b) deployments where workers span hosts without ICI.
+
+Differences from the reference, deliberate:
+- Flask is not in this environment; ``http.server.ThreadingHTTPServer`` serves
+  the same two routes with the same pickle payloads.
+- The server runs in a daemon *thread*, not a forked ``multiprocessing``
+  process — workers here are threads in the same process (local mesh), so a
+  fork would only add IPC latency. The lock/hogwild distinction is unchanged.
+
+Security note: payloads are pickled Python objects, exactly like the
+reference — only ever bind these servers on trusted networks.
+"""
+
+from __future__ import annotations
+
+import http.server
+import pickle
+import socket
+import threading
+from typing import Any, List, Optional
+
+import numpy as np
+
+from ..utils import sockets as socket_utils
+from ..utils.functional_utils import subtract_params_np
+
+
+class BaseParameterServer:
+    """Common state: the master weight list, a lock, lifecycle flags.
+
+    ``mode='hogwild'`` skips lock acquisition on update, accepting races by
+    design (reference ``parameter/server.py:~70``).
+    """
+
+    def __init__(self, weights: List[np.ndarray], mode: str = "asynchronous",
+                 port: int = 4000, **_kwargs):
+        self.weights = [np.array(w) for w in weights]
+        self.mode = mode
+        self.port = int(port)
+        self.lock = threading.Lock()
+        self._running = False
+
+    # -- weight ops ------------------------------------------------------
+    def apply_delta(self, delta: List[np.ndarray]) -> None:
+        if self.mode == "hogwild":
+            # Lock-free by design: concurrent updates may interleave
+            # per-array — HOGWILD! semantics.
+            self.weights = subtract_params_np(self.weights, delta)
+        else:
+            with self.lock:
+                self.weights = subtract_params_np(self.weights, delta)
+
+    def get_weights(self) -> List[np.ndarray]:
+        return self.weights
+
+    def start(self) -> None:
+        raise NotImplementedError
+
+    def stop(self) -> None:
+        raise NotImplementedError
+
+
+class HttpServer(BaseParameterServer):
+    """``GET /parameters`` → pickled weights; ``POST /update`` → apply delta.
+
+    Same routes and payloads as the reference's Flask service
+    (``parameter/server.py:~30``).
+    """
+
+    def __init__(self, weights: List[np.ndarray], mode: str = "asynchronous",
+                 port: int = 4000, debug: bool = False, **kwargs):
+        super().__init__(weights, mode=mode, port=port, **kwargs)
+        self.debug = debug
+        self._httpd: Optional[http.server.ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        server = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet unless debug
+                if server.debug:
+                    http.server.BaseHTTPRequestHandler.log_message(self, *args)
+
+            def do_GET(self):
+                if self.path.rstrip("/") == "/parameters" or self.path == "/":
+                    payload = pickle.dumps(
+                        server.get_weights(), protocol=pickle.HIGHEST_PROTOCOL
+                    )
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/octet-stream")
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                if self.path.rstrip("/") == "/update":
+                    length = int(self.headers.get("Content-Length", 0))
+                    delta = pickle.loads(self.rfile.read(length))
+                    server.apply_delta(delta)
+                    self.send_response(200)
+                    self.send_header("Content-Length", "2")
+                    self.end_headers()
+                    self.wfile.write(b"ok")
+                else:
+                    self.send_error(404)
+
+        self._httpd = http.server.ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        self._running = True
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._running = False
+
+
+class SocketServer(BaseParameterServer):
+    """Raw-TCP server: 1-byte opcodes ``b'g'`` (get) / ``b'u'`` (update),
+    fixed-width-header pickle framing from ``elephas_tpu.utils.sockets``.
+
+    Reference: ``parameter/server.py:~100`` (``action_listener`` thread per
+    accepted connection).
+    """
+
+    def __init__(self, weights: List[np.ndarray], mode: str = "asynchronous",
+                 port: int = 4000, **kwargs):
+        super().__init__(weights, mode=mode, port=port, **kwargs)
+        self._sock: Optional[socket.socket] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop_event = threading.Event()
+        self._conn_threads: List[threading.Thread] = []
+
+    def start(self) -> None:
+        self._stop_event.clear()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", self.port))
+        self._sock.listen(16)
+        self._sock.settimeout(0.2)
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+        self._running = True
+
+    def _accept_loop(self) -> None:
+        while not self._stop_event.is_set():
+            try:
+                conn, _addr = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._action_listener, args=(conn,), daemon=True)
+            t.start()
+            self._conn_threads.append(t)
+
+    def _action_listener(self, conn: socket.socket) -> None:
+        try:
+            while not self._stop_event.is_set():
+                op = conn.recv(1)
+                if not op:
+                    break
+                if op == b"g":
+                    socket_utils.send(conn, self.get_weights())
+                elif op == b"u":
+                    delta = socket_utils.receive(conn)
+                    self.apply_delta(delta)
+                else:
+                    break
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self) -> None:
+        self._stop_event.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+        self._running = False

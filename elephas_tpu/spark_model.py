@@ -1,0 +1,385 @@
+"""Core engine / driver orchestration: ``SparkModel`` and ``SparkMLlibModel``.
+
+Rebuild of reference ``elephas/spark_model.py:~1``. The public surface is the
+reference's (constructor signature, ``fit(rdd, epochs, batch_size, verbose,
+validation_split)``, ``predict``, ``master_network``, ``save`` /
+``load_spark_model``), but the execution underneath is TPU-native:
+
+- **Fast path (default)** — all of training compiles into ONE XLA program
+  ``shard_map``-ed over a ``jax.sharding.Mesh``: per-worker replicas train in
+  ``lax.scan`` loops and merge by ``psum`` over ICI
+  (:mod:`elephas_tpu.parallel.engine`). The driver's remaining job is exactly
+  what the north star prescribes: shard data onto chips, read back weights.
+- **Host path (compatibility)** — the reference's literal architecture:
+  worker generators consumed through ``rdd.mapPartitions(...)`` (threads),
+  synchronous deltas merged on the driver, async/hogwild workers pushing to a
+  live HTTP/Socket parameter server (:mod:`elephas_tpu.parameter`).
+
+Path selection: ``parameter_server_mode='jax'`` (async modes) / default for
+synchronous → fast path; ``'http'`` / ``'socket'`` → host path, which is also
+the reference's default, so reference user code gets reference behavior
+unchanged. Pass ``parameter_server_mode='jax'`` (or ``comm='jax'``) to opt
+into on-device merging.
+
+Reference behaviors kept: ``rdd.repartition(num_workers)`` before training
+(``spark_model.py:~100``), partitions ``<= batch_size`` skipped
+(``worker.py:~45``), sync merge = delta averaging (fork ``divide_by``
+semantics; ``merge='sum'`` gives upstream sequential-subtract semantics),
+async merge = full-delta application (Downpour).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .data.rdd import RDD
+from .mllib.adapter import from_matrix, from_vector, to_matrix, to_vector
+from .mllib.linalg import DenseMatrix, DenseVector
+from .parallel.engine import CompiledTrainer
+from .parallel.mesh import build_mesh
+from .parameter.client import BaseParameterClient
+from .parameter.server import HttpServer, SocketServer
+from .utils.rdd_utils import lp_to_simple_rdd
+from .worker import AsynchronousSparkWorker, SparkWorker
+
+
+def _serialize_optimizer(optimizer) -> Any:
+    """Keras optimizer → a config each worker can rebuild a FRESH optimizer
+    from (reference ships ``master_optimizer`` the same way)."""
+    if optimizer is None:
+        return "sgd"
+    if isinstance(optimizer, str):
+        return optimizer
+    import keras
+
+    try:
+        return keras.optimizers.serialize(optimizer)
+    except Exception:
+        return "sgd"
+
+
+class SparkModel:
+    """Distributed data-parallel trainer for a compiled Keras model."""
+
+    def __init__(self, model, mode: str = "asynchronous", frequency: str = "epoch",
+                 parameter_server_mode: str = "http",
+                 num_workers: Optional[int] = None,
+                 custom_objects: Optional[dict] = None, batch_size: int = 32,
+                 port: int = 4000, mesh=None, merge: str = "auto",
+                 comm: Optional[str] = None,
+                 master_optimizer=None, master_loss=None, master_metrics=None,
+                 *args, **kwargs):
+        if mode not in ("synchronous", "asynchronous", "hogwild"):
+            raise ValueError(f"Unknown mode: {mode}")
+        if parameter_server_mode not in ("http", "socket", "jax"):
+            raise ValueError(
+                f"Unknown parameter_server_mode: {parameter_server_mode}"
+            )
+        self._master_network = model
+        self.mode = mode
+        self.frequency = frequency
+        self.parameter_server_mode = parameter_server_mode
+        self.num_workers = num_workers
+        self.custom_objects = custom_objects
+        self.batch_size = batch_size
+        self.port = port
+        self.merge = merge
+        self.mesh = mesh
+        # comm overrides: 'jax' = on-device engine, 'host' = reference-shaped
+        # host path. Default: sync → jax; async → per parameter_server_mode.
+        if comm is None:
+            if mode == "synchronous":
+                comm = "jax"
+            else:
+                comm = "jax" if parameter_server_mode == "jax" else "host"
+        self.comm = comm
+        self.master_optimizer = (
+            master_optimizer
+            if master_optimizer is not None
+            else _serialize_optimizer(getattr(model, "optimizer", None))
+        )
+        self.master_loss = (
+            master_loss if master_loss is not None else getattr(model, "loss", None)
+        )
+        self.master_metrics = master_metrics
+        self.training_histories: List[Dict[str, Any]] = []
+        self._server = None
+        self.client: Optional[BaseParameterClient] = None
+
+    # -- properties ------------------------------------------------------
+    @property
+    def master_network(self):
+        return self._master_network
+
+    @master_network.setter
+    def master_network(self, network):
+        self._master_network = network
+
+    def get_config(self) -> Dict[str, Any]:
+        return {
+            "mode": self.mode,
+            "frequency": self.frequency,
+            "parameter_server_mode": self.parameter_server_mode,
+            "num_workers": self.num_workers,
+            "batch_size": self.batch_size,
+            "port": self.port,
+            "merge": self.merge,
+            "comm": self.comm,
+        }
+
+    # -- training --------------------------------------------------------
+    def fit(self, rdd: RDD, epochs: int = 10, batch_size: Optional[int] = None,
+            verbose: int = 0, validation_split: float = 0.1, **kwargs) -> None:
+        """Train on an RDD of ``(x, y)`` sample pairs.
+
+        Mirrors reference ``SparkModel.fit`` (``spark_model.py:~100``):
+        repartitions to ``num_workers`` and dispatches per mode.
+        """
+        batch_size = self.batch_size if batch_size is None else batch_size
+        num_workers = self._resolve_num_workers()
+        if rdd.getNumPartitions() != num_workers:
+            rdd = rdd.repartition(num_workers)
+        self._fit(rdd, epochs, batch_size, verbose, validation_split)
+
+    def _resolve_num_workers(self) -> int:
+        if self.num_workers is not None:
+            return int(self.num_workers)
+        if self.mesh is not None:
+            return int(self.mesh.devices.size)
+        import jax
+
+        return jax.local_device_count()
+
+    def _partition_blocks(self, rdd: RDD, batch_size: int):
+        """Partitions → dense per-worker blocks, skipping ``<= batch_size``
+        partitions (the reference worker guard)."""
+        blocks = []
+        for part in rdd.partitions():
+            if not part:
+                continue
+            xs = np.stack([np.asarray(x) for x, _ in part])
+            ys = np.stack([np.asarray(y) for _, y in part])
+            if xs.shape[0] <= batch_size:
+                continue
+            blocks.append((xs, ys))
+        return blocks
+
+    def _fit(self, rdd: RDD, epochs: int, batch_size: int, verbose: int,
+             validation_split: float) -> None:
+        if self.comm == "jax":
+            self._fit_jax(rdd, epochs, batch_size, verbose, validation_split)
+        elif self.mode == "synchronous":
+            self._fit_host_sync(rdd, epochs, batch_size, verbose, validation_split)
+        else:
+            self._fit_host_async(rdd, epochs, batch_size, verbose, validation_split)
+
+    # -- fast path: one XLA program over the mesh ------------------------
+    def _fit_jax(self, rdd, epochs, batch_size, verbose, validation_split):
+        from .models.adapters import KerasModelAdapter
+
+        blocks = self._partition_blocks(rdd, batch_size)
+        if not blocks:
+            raise ValueError(
+                "All partitions were skipped (each needs > batch_size samples)"
+            )
+        mesh = self.mesh if self.mesh is not None else build_mesh()
+        adapter = KerasModelAdapter(
+            self._master_network,
+            loss=self.master_loss,
+            optimizer=self.master_optimizer,
+            metrics=self.master_metrics,
+            custom_objects=self.custom_objects,
+        )
+        trainer = CompiledTrainer(
+            adapter, mesh, mode=self.mode, frequency=self.frequency,
+            merge=self.merge,
+        )
+        result = trainer.fit(
+            blocks, epochs=epochs, batch_size=batch_size,
+            validation_split=validation_split, verbose=verbose,
+        )
+        self.training_histories.append(result.history)
+
+    # -- host path: reference-shaped synchronous -------------------------
+    def _fit_host_sync(self, rdd, epochs, batch_size, verbose, validation_split):
+        model = self._master_network
+        train_config = {
+            "epochs": epochs,
+            "batch_size": batch_size,
+            "verbose": verbose,
+            "validation_split": validation_split,
+        }
+        parameters = rdd.context.broadcast(model.get_weights())
+        worker = SparkWorker(
+            model.to_json(), parameters, train_config,
+            self.master_optimizer, self.master_loss, self.master_metrics,
+            self.custom_objects,
+        )
+        results = rdd.mapPartitions(worker.train).collect()
+        deltas = [r[0] for r in results]
+        self.training_histories.extend(r[1] for r in results if r[1])
+        if not deltas:
+            raise ValueError(
+                "All partitions were skipped (each needs > batch_size samples)"
+            )
+        new_parameters = [np.array(w) for w in model.get_weights()]
+        merge = "mean" if self.merge == "auto" else self.merge
+        scale = 1.0 / len(deltas) if merge == "mean" else 1.0
+        for delta in deltas:
+            new_parameters = [
+                p - scale * np.asarray(d) for p, d in zip(new_parameters, delta)
+            ]
+        model.set_weights(new_parameters)
+
+    # -- host path: reference-shaped async/hogwild against a live PS -----
+    def start_server(self) -> None:
+        weights = self._master_network.get_weights()
+        cls = HttpServer if self.parameter_server_mode == "http" else SocketServer
+        self._server = cls(weights, mode=self.mode, port=self.port)
+        self._server.start()
+
+    def stop_server(self) -> None:
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+
+    def _fit_host_async(self, rdd, epochs, batch_size, verbose, validation_split):
+        model = self._master_network
+        self.start_server()
+        try:
+            train_config = {
+                "epochs": epochs,
+                "batch_size": batch_size,
+                "verbose": verbose,
+                "validation_split": validation_split,
+            }
+
+            def make_train(json_config, ps_mode, port, train_config, frequency,
+                           opt, loss, metrics, custom_objects):
+                # Each partition gets its OWN client (thread) — mirrors one
+                # client per executor in the reference.
+                def run(iterator):
+                    client = BaseParameterClient.get_client(
+                        ps_mode, port, host="127.0.0.1"
+                    )
+                    worker = AsynchronousSparkWorker(
+                        json_config, client, train_config, frequency,
+                        opt, loss, metrics, custom_objects,
+                    )
+                    yield from worker.train(iterator)
+                    client.close()
+
+                return run
+
+            fn = make_train(
+                model.to_json(), self.parameter_server_mode, self.port,
+                train_config, self.frequency, self.master_optimizer,
+                self.master_loss, self.master_metrics, self.custom_objects,
+            )
+            rdd.mapPartitions(fn).collect()
+            client = BaseParameterClient.get_client(
+                self.parameter_server_mode, self.port, host="127.0.0.1"
+            )
+            new_parameters = client.get_parameters()
+            client.close()
+            model.set_weights(new_parameters)
+        finally:
+            self.stop_server()
+
+    # -- inference -------------------------------------------------------
+    def predict(self, data):
+        """Predict on a numpy array (driver-local, reference behavior) or an
+        RDD of feature rows (distributed, maintained-fork behavior)."""
+        model = self._master_network
+        if isinstance(data, RDD):
+            json_config = model.to_json()
+            weights = data.context.broadcast(model.get_weights())
+            custom_objects = self.custom_objects
+
+            def predict_partition(iterator):
+                rows = [np.asarray(x) for x in iterator]
+                if not rows:
+                    return
+                import keras
+
+                replica = keras.models.model_from_json(
+                    json_config, custom_objects=custom_objects
+                )
+                replica.set_weights(weights.value)
+                preds = replica.predict(np.stack(rows), verbose=0)
+                yield from preds
+
+            return data.mapPartitions(predict_partition)
+        return model.predict(np.asarray(data), verbose=0)
+
+    def evaluate(self, x, y, **kwargs):
+        return self._master_network.evaluate(
+            np.asarray(x), np.asarray(y), verbose=kwargs.get("verbose", 0)
+        )
+
+    # -- persistence -----------------------------------------------------
+    def save(self, path: str) -> None:
+        """Whole-model save (reference ``spark_model.py:~90``): Keras file +
+        a sidecar JSON with elephas config."""
+        self._master_network.save(path)
+        meta = self.get_config()
+        with open(path + ".elephas.json", "w") as f:
+            json.dump(meta, f)
+
+    @property
+    def training_histories_(self):
+        return self.training_histories
+
+
+def load_spark_model(path: str, custom_objects: Optional[dict] = None) -> SparkModel:
+    """Reference ``load_spark_model`` (``spark_model.py:~25``)."""
+    import keras
+
+    model = keras.models.load_model(path, custom_objects=custom_objects)
+    config: Dict[str, Any] = {}
+    sidecar = path + ".elephas.json"
+    if os.path.exists(sidecar):
+        with open(sidecar) as f:
+            config = json.load(f)
+    return SparkModel(
+        model,
+        mode=config.get("mode", "asynchronous"),
+        frequency=config.get("frequency", "epoch"),
+        parameter_server_mode=config.get("parameter_server_mode", "http"),
+        num_workers=config.get("num_workers"),
+        custom_objects=custom_objects,
+        batch_size=config.get("batch_size", 32),
+        port=config.get("port", 4000),
+        merge=config.get("merge", "auto"),
+        comm=config.get("comm"),
+    )
+
+
+class SparkMLlibModel(SparkModel):
+    """LabeledPoint-RDD skin (reference ``spark_model.py:~200``)."""
+
+    def fit(self, labeled_points: RDD, epochs: int = 10,
+            batch_size: Optional[int] = None, verbose: int = 0,
+            validation_split: float = 0.1, categorical: bool = False,
+            nb_classes: Optional[int] = None, **kwargs) -> None:
+        rdd = lp_to_simple_rdd(labeled_points, categorical, nb_classes)
+        batch_size = self.batch_size if batch_size is None else batch_size
+        num_workers = self._resolve_num_workers()
+        rdd = rdd.repartition(num_workers)
+        self._fit(rdd, epochs, batch_size, verbose, validation_split)
+
+    def predict(self, mllib_data):
+        """Predict on an MLlib ``Vector``/``Matrix``, returning the same type
+        (reference ``spark_model.py:~230``)."""
+        if isinstance(mllib_data, DenseMatrix):
+            return to_matrix(
+                self._master_network.predict(from_matrix(mllib_data), verbose=0)
+            )
+        if isinstance(mllib_data, DenseVector):
+            features = from_vector(mllib_data)[None, :]
+            return to_vector(self._master_network.predict(features, verbose=0)[0])
+        return super().predict(mllib_data)

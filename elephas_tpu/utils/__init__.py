@@ -1,0 +1,37 @@
+from .functional_utils import (
+    add_params,
+    divide_by,
+    get_neutral,
+    mean_params,
+    scale_params,
+    subtract_params,
+)
+from .rdd_utils import (
+    encode_label,
+    from_labeled_point,
+    lp_to_simple_rdd,
+    to_labeled_point,
+    to_simple_rdd,
+)
+from .serialization import dict_to_model, model_to_dict
+from .sockets import determine_master, receive, receive_all, send
+
+__all__ = [
+    "add_params",
+    "subtract_params",
+    "get_neutral",
+    "divide_by",
+    "scale_params",
+    "mean_params",
+    "to_simple_rdd",
+    "to_labeled_point",
+    "from_labeled_point",
+    "lp_to_simple_rdd",
+    "encode_label",
+    "model_to_dict",
+    "dict_to_model",
+    "determine_master",
+    "send",
+    "receive",
+    "receive_all",
+]

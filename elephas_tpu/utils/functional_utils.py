@@ -1,0 +1,74 @@
+"""Elementwise math over model-weight pytrees.
+
+TPU-native rebuild of the reference's ``elephas/utils/functional_utils.py:~1``
+(``add_params``, ``subtract_params``, ``get_neutral``, ``divide_by`` over lists
+of numpy arrays). Here the same operations are defined over arbitrary JAX
+pytrees (lists of arrays included, so the reference call signatures hold
+verbatim), are jit-traceable, and run on-device when handed ``jax.Array``s.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def add_params(p1, p2):
+    """Elementwise ``p1 + p2`` over two pytrees of weights.
+
+    Mirror of reference ``functional_utils.add_params`` which zips two lists of
+    numpy arrays; this version accepts any matching pytree.
+    """
+    return jax.tree_util.tree_map(jnp.add, p1, p2)
+
+
+def subtract_params(p1, p2):
+    """Elementwise ``p1 - p2`` over two pytrees of weights.
+
+    Reference: ``functional_utils.subtract_params``. In elephas semantics the
+    training *delta* is ``subtract_params(weights_before, weights_after)`` and
+    applying a delta to master weights is again ``subtract_params(master,
+    delta)``.
+    """
+    return jax.tree_util.tree_map(jnp.subtract, p1, p2)
+
+
+def get_neutral(params):
+    """A pytree of zeros with the same structure/shapes/dtypes as ``params``.
+
+    Reference: ``functional_utils.get_neutral`` (zeros_like over a weight
+    list) — the neutral element of delta accumulation.
+    """
+    return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+
+def divide_by(params, num_workers):
+    """Scale every leaf by ``1 / num_workers``.
+
+    Reference: ``functional_utils.divide_by`` — used by the delta-averaging
+    merge.
+    """
+    return jax.tree_util.tree_map(lambda w: w / num_workers, params)
+
+
+def scale_params(params, factor):
+    """Scale every leaf by ``factor`` (TPU-build extension)."""
+    return jax.tree_util.tree_map(lambda w: w * factor, params)
+
+
+def subtract_params_np(p1, p2):
+    """Pure-numpy ``p1 - p2`` over weight lists — the host-path variant used
+    by workers and parameter servers, which keep weights as numpy so payloads
+    pickle without device round-trips."""
+    import numpy as np
+
+    return [np.asarray(a) - np.asarray(b) for a, b in zip(p1, p2)]
+
+
+def mean_params(params_list):
+    """Average a list of weight pytrees (TPU-build extension used by merges)."""
+    n = len(params_list)
+    summed = params_list[0]
+    for p in params_list[1:]:
+        summed = add_params(summed, p)
+    return divide_by(summed, n)

@@ -1,0 +1,42 @@
+"""Keras model (de)serialization.
+
+Rebuild of reference ``elephas/utils/serialization.py:~1``:
+``model_to_dict`` / ``dict_to_model``. The reference stores ``{'model':
+model.to_yaml(), 'weights': model.get_weights()}``; Keras 3 removed YAML, so
+the architecture travels as the JSON config (the newer-TF variant the
+maintained fork already uses — SURVEY.md §2.5) and weights as a list of numpy
+arrays. Also provides npz-based weight persistence used by checkpointing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+def model_to_dict(model) -> Dict[str, Any]:
+    """Keras model → ``{'model': <json str>, 'weights': [np.ndarray, ...]}``."""
+    return {
+        "model": model.to_json(),
+        "weights": [np.asarray(w) for w in model.get_weights()],
+    }
+
+
+def dict_to_model(d: Dict[str, Any], custom_objects: Optional[dict] = None):
+    """Inverse of :func:`model_to_dict`."""
+    import keras
+
+    model = keras.models.model_from_json(d["model"], custom_objects=custom_objects)
+    model.set_weights(d["weights"])
+    return model
+
+
+def save_weights_npz(path: str, weights: List[np.ndarray]) -> None:
+    """Persist a weight list as an ordered npz archive (TPU-build extension)."""
+    np.savez(path, **{f"w{i}": np.asarray(w) for i, w in enumerate(weights)})
+
+
+def load_weights_npz(path: str) -> List[np.ndarray]:
+    with np.load(path) as data:
+        return [data[f"w{i}"] for i in range(len(data.files))]

@@ -1,0 +1,70 @@
+"""Socket helpers + master discovery.
+
+Rebuild of reference ``elephas/utils/sockets.py:~1``:
+
+- ``determine_master(port)`` — reference reads ``SPARK_LOCAL_IP`` else
+  resolves the local hostname; the address is baked into the worker closure at
+  serialization time so executors can find the driver-hosted parameter server
+  (SURVEY.md §2.4). Same here, with a TPU-era addition: the
+  ``ELEPHAS_MASTER`` env var wins, and on multi-host JAX deployments the
+  coordinator address from ``jax.distributed`` can be passed explicitly.
+- ``send`` / ``receive`` / ``receive_all`` — the raw-TCP framing the Socket
+  parameter server speaks: a fixed-width ASCII length header followed by a
+  pickled payload (reference ``utils/sockets.py:~25``). Kept wire-compatible
+  so a reference SocketClient could in principle talk to this server.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+from typing import Any
+
+#: Fixed width of the ASCII length header (reference uses a fixed-width
+#: decimal header; 20 digits comfortably covers any picklable payload).
+HEADER_WIDTH = 20
+
+
+def determine_master(port: int = 4000) -> str:
+    """Return ``host:port`` of the driver/parameter-server endpoint."""
+    if os.environ.get("ELEPHAS_MASTER"):
+        host = os.environ["ELEPHAS_MASTER"]
+        if ":" in host:
+            return host
+        return f"{host}:{port}"
+    if os.environ.get("SPARK_LOCAL_IP"):
+        return f"{os.environ['SPARK_LOCAL_IP']}:{port}"
+    try:
+        host = socket.gethostbyname(socket.gethostname())
+    except socket.gaierror:
+        host = "127.0.0.1"
+    return f"{host}:{port}"
+
+
+def receive_all(sock: socket.socket, num_bytes: int) -> bytes:
+    """Read exactly ``num_bytes`` from ``sock`` (reference ``receive_all``)."""
+    chunks = []
+    remaining = num_bytes
+    while remaining > 0:
+        chunk = sock.recv(min(remaining, 1 << 20))
+        if not chunk:
+            raise ConnectionError("socket closed before full message received")
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks)
+
+
+def send(sock: socket.socket, data: Any) -> None:
+    """Pickle ``data`` and send with a fixed-width ASCII length header."""
+    payload = pickle.dumps(data, protocol=pickle.HIGHEST_PROTOCOL)
+    header = str(len(payload)).zfill(HEADER_WIDTH).encode("ascii")
+    sock.sendall(header + payload)
+
+
+def receive(sock: socket.socket) -> Any:
+    """Receive one framed pickled message (inverse of :func:`send`)."""
+    header = receive_all(sock, HEADER_WIDTH)
+    length = int(header.decode("ascii"))
+    payload = receive_all(sock, length)
+    return pickle.loads(payload)

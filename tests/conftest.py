@@ -1,0 +1,105 @@
+"""Test fixtures.
+
+Mirrors the reference's test strategy (SURVEY.md §4): a real local "cluster"
+fixture (here: an 8-device CPU mesh via ``--xla_force_host_platform_device_count``,
+the JAX analog of Spark ``local[8]``), small Keras model factories, and tiny
+synthetic datasets.
+
+IMPORTANT environment note: run tests with the axon TPU registration disabled
+and CPU forced, or the sitecustomize TPU claim serializes every python
+process::
+
+    PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 KERAS_BACKEND=jax \
+    python -m pytest tests/ -x -q
+
+(`make test` does exactly this.) The settings below are a best-effort fallback
+for when jax has not yet initialized a backend.
+"""
+
+import os
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    )
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def spark_context():
+    from elephas_tpu.data import SparkContext
+
+    sc = SparkContext(master="local[8]", appName="elephas-tpu-tests")
+    yield sc
+    sc.stop()
+
+
+@pytest.fixture(scope="session")
+def spark_session():
+    from elephas_tpu.data import SparkSession
+
+    session = SparkSession.builder.master("local[8]").appName("tests").getOrCreate()
+    yield session
+
+
+@pytest.fixture(scope="session")
+def toy_classification():
+    """Linearly-separable-ish 3-class problem: (X [640,10], Y one-hot [640,3])."""
+    rng = np.random.default_rng(42)
+    n, d, c = 640, 10, 3
+    x = rng.normal(size=(n, d)).astype("float32")
+    w = rng.normal(size=(d, c))
+    y = np.eye(c, dtype="float32")[(x @ w).argmax(axis=1)]
+    return x, y
+
+
+@pytest.fixture(scope="session")
+def toy_regression():
+    rng = np.random.default_rng(7)
+    n, d = 512, 8
+    x = rng.normal(size=(n, d)).astype("float32")
+    w = rng.normal(size=(d,))
+    y = (x @ w + 0.05 * rng.normal(size=(n,))).astype("float32")
+    return x, y
+
+
+def make_classifier(input_dim=10, nb_classes=3, hidden=32, optimizer="adam"):
+    import keras
+
+    model = keras.Sequential(
+        [
+            keras.layers.Dense(hidden, activation="relu"),
+            keras.layers.Dense(nb_classes, activation="softmax"),
+        ]
+    )
+    model.build((None, input_dim))
+    model.compile(
+        optimizer=optimizer, loss="categorical_crossentropy", metrics=["accuracy"]
+    )
+    return model
+
+
+def make_regressor(input_dim=8, hidden=16):
+    import keras
+
+    model = keras.Sequential(
+        [keras.layers.Dense(hidden, activation="relu"), keras.layers.Dense(1)]
+    )
+    model.build((None, input_dim))
+    model.compile(optimizer="adam", loss="mse")
+    return model
+
+
+@pytest.fixture
+def classifier_factory():
+    return make_classifier
+
+
+@pytest.fixture
+def regressor_factory():
+    return make_regressor

@@ -1,0 +1,45 @@
+"""Spark-SQL facade semantics (the DataFrame surface the ML skin uses)."""
+
+import numpy as np
+import pytest
+
+from elephas_tpu.data import Row, SparkSession
+from elephas_tpu.mllib import Vectors
+
+
+def test_row_access():
+    r = Row(features=[1, 2], label=1.0)
+    assert r.label == 1.0
+    assert r["features"] == [1, 2]
+    assert r.asDict() == {"features": [1, 2], "label": 1.0}
+    with pytest.raises(AttributeError):
+        _ = r.missing
+
+
+def test_create_dataframe_and_select(spark_session):
+    df = spark_session.createDataFrame(
+        [(1.0, 2.0), (3.0, 4.0)], schema=["a", "b"]
+    )
+    assert df.columns == ["a", "b"]
+    assert df.count() == 2
+    sel = df.select("b")
+    assert sel.columns == ["b"]
+    assert [r.b for r in sel.collect()] == [2.0, 4.0]
+
+
+def test_with_column_and_rdd(spark_session):
+    df = spark_session.createDataFrame(
+        [Row(features=Vectors.dense([1.0, 0.0]), label=0.0),
+         Row(features=Vectors.dense([0.0, 1.0]), label=1.0)]
+    )
+    df2 = df.withColumn("prediction", lambda r: r.label + 1)
+    assert [r.prediction for r in df2.collect()] == [1.0, 2.0]
+    feats = df.rdd.map(lambda r: r.features.toArray()).collect()
+    assert np.allclose(feats[1], [0.0, 1.0])
+
+
+def test_random_split(spark_session):
+    df = spark_session.createDataFrame([(float(i),) for i in range(100)], ["v"])
+    a, b = df.randomSplit([0.8, 0.2], seed=1)
+    assert a.count() + b.count() == 100
+    assert 60 <= a.count() <= 95

@@ -1,0 +1,78 @@
+"""Spark-core facade semantics (SURVEY.md §7.0: the Spark surface elephas uses)."""
+
+import threading
+import time
+
+from elephas_tpu.data import SparkConf, SparkContext
+
+
+def test_parallelize_slicing(spark_context):
+    rdd = spark_context.parallelize(list(range(10)), 3)
+    parts = rdd.partitions()
+    assert len(parts) == 3
+    assert sum(len(p) for p in parts) == 10
+    # Spark-style contiguous slicing
+    assert parts[0] + parts[1] + parts[2] == list(range(10))
+
+
+def test_repartition_balance(spark_context):
+    rdd = spark_context.parallelize(list(range(100)), 2).repartition(8)
+    sizes = [len(p) for p in rdd.partitions()]
+    assert len(sizes) == 8
+    assert max(sizes) - min(sizes) <= 1
+    assert sorted(rdd.collect()) == list(range(100))
+
+
+def test_map_filter_collect_count(spark_context):
+    rdd = spark_context.parallelize(list(range(10)), 4)
+    out = rdd.map(lambda v: v * 2).filter(lambda v: v % 4 == 0)
+    assert sorted(out.collect()) == [0, 4, 8, 12, 16]
+    assert out.count() == 5
+
+
+def test_map_partitions_generator(spark_context):
+    rdd = spark_context.parallelize(list(range(12)), 4)
+
+    def gen(it):
+        yield sum(it)
+
+    sums = rdd.mapPartitions(gen).collect()
+    assert len(sums) == 4
+    assert sum(sums) == sum(range(12))
+
+
+def test_map_partitions_concurrency():
+    """Partitions must run concurrently (async-mode interleaving depends on it)."""
+    sc = SparkContext(master="local[4]")
+    barrier = threading.Barrier(4, timeout=10)
+
+    def wait_all(it):
+        barrier.wait()  # deadlocks unless all 4 partitions run concurrently
+        yield len(list(it))
+
+    rdd = sc.parallelize(list(range(8)), 4)
+    out = rdd.mapPartitions(wait_all).collect()
+    assert sum(out) == 8
+
+
+def test_broadcast(spark_context):
+    b = spark_context.broadcast({"w": [1, 2, 3]})
+    rdd = spark_context.parallelize([0, 1], 2)
+    out = rdd.mapPartitions(lambda it: iter([b.value["w"][0]])).collect()
+    assert out == [1, 1]
+
+
+def test_zip_and_take(spark_context):
+    a = spark_context.parallelize([1, 2, 3], 2)
+    b = spark_context.parallelize(["a", "b", "c"], 3)
+    assert a.zip(b).collect() == [(1, "a"), (2, "b"), (3, "c")]
+    assert a.take(2) == [1, 2]
+    assert a.first() == 1
+
+
+def test_spark_conf_construction():
+    conf = SparkConf().setMaster("local[2]").setAppName("x")
+    sc = SparkContext(conf=conf)
+    assert sc.defaultParallelism == 2
+    assert sc.appName == "x"
+    sc.stop()

@@ -1,0 +1,130 @@
+"""End-to-end SparkModel training matrix.
+
+The reference's distributed test suite IS this matrix (SURVEY.md §4):
+mode × parameter-server backend × frequency, trained on a small dataset,
+asserting training ran and improved the model. Here the matrix additionally
+covers the TPU fast path (``parameter_server_mode='jax'``, on-device psum
+merges) next to the reference-shaped host paths (collect / HTTP PS / socket
+PS with real thread interleaving).
+"""
+
+import numpy as np
+import pytest
+
+from elephas_tpu import SparkModel, load_spark_model
+from elephas_tpu.utils import to_simple_rdd
+
+from ..conftest import make_classifier
+
+PORTS = iter(range(42000, 42100))
+
+
+def _accuracy(model, x, y):
+    preds = model.predict(x, verbose=0)
+    return float((preds.argmax(1) == y.argmax(1)).mean())
+
+
+@pytest.fixture
+def rdd(spark_context, toy_classification):
+    x, y = toy_classification
+    return to_simple_rdd(spark_context, x, y)
+
+
+# -- the matrix --------------------------------------------------------------
+
+MATRIX = [
+    # (mode, ps_mode, frequency)
+    ("synchronous", "jax", "epoch"),
+    ("asynchronous", "jax", "epoch"),
+    ("asynchronous", "jax", "batch"),
+    ("hogwild", "jax", "epoch"),
+    ("asynchronous", "http", "epoch"),
+    ("asynchronous", "http", "batch"),
+    ("asynchronous", "socket", "epoch"),
+    ("hogwild", "http", "epoch"),
+    ("hogwild", "socket", "batch"),
+]
+
+
+@pytest.mark.parametrize("mode,ps_mode,frequency", MATRIX)
+def test_training_matrix(mode, ps_mode, frequency, rdd, toy_classification):
+    x, y = toy_classification
+    model = make_classifier()
+    base_acc = _accuracy(model, x, y)
+    spark_model = SparkModel(
+        model,
+        mode=mode,
+        frequency=frequency,
+        parameter_server_mode=ps_mode,
+        num_workers=4,
+        port=next(PORTS),
+        merge="mean",
+    )
+    spark_model.fit(rdd, epochs=4, batch_size=16, verbose=0, validation_split=0.0)
+    acc = _accuracy(spark_model.master_network, x, y)
+    assert acc > max(base_acc, 0.34), f"no improvement: {base_acc} -> {acc}"
+
+
+def test_sync_host_path_matches_reference_shape(rdd, toy_classification):
+    """Synchronous over the host collect path (the reference's literal merge)."""
+    x, y = toy_classification
+    model = make_classifier()
+    base_acc = _accuracy(model, x, y)
+    spark_model = SparkModel(model, mode="synchronous", num_workers=4, comm="host")
+    spark_model.fit(rdd, epochs=4, batch_size=16, validation_split=0.0)
+    assert _accuracy(spark_model.master_network, x, y) > base_acc
+    assert spark_model.training_histories  # per-worker Keras histories collected
+
+
+def test_sync_jax_records_history(rdd):
+    model = make_classifier()
+    spark_model = SparkModel(model, mode="synchronous", num_workers=4)
+    spark_model.fit(rdd, epochs=3, batch_size=16, validation_split=0.2)
+    h = spark_model.training_histories[-1]
+    assert len(h["loss"]) == 3
+    assert "val_loss" in h and "accuracy" in h
+    assert h["loss"][-1] < h["loss"][0]
+
+
+def test_small_partitions_skipped(spark_context):
+    """Partitions with <= batch_size samples are skipped (reference quirk)."""
+    x = np.random.default_rng(0).normal(size=(40, 10)).astype("float32")
+    y = np.eye(3, dtype="float32")[np.random.default_rng(1).integers(0, 3, 40)]
+    rdd = to_simple_rdd(spark_context, x, y)
+    model = make_classifier()
+    # 40 samples over 4 workers = 10 each <= batch_size 16 → everything skipped
+    spark_model = SparkModel(model, mode="synchronous", num_workers=4)
+    with pytest.raises(ValueError, match="skipped"):
+        spark_model.fit(rdd, epochs=1, batch_size=16, validation_split=0.0)
+
+
+def test_predict_array_and_rdd(rdd, toy_classification, spark_context):
+    x, y = toy_classification
+    model = make_classifier()
+    spark_model = SparkModel(model, mode="synchronous", num_workers=4)
+    spark_model.fit(rdd, epochs=1, batch_size=16, validation_split=0.0)
+    preds = spark_model.predict(x[:10])
+    assert preds.shape == (10, 3)
+    feature_rdd = spark_context.parallelize([row for row in x[:10]], 2)
+    dist_preds = np.stack(spark_model.predict(feature_rdd).collect())
+    assert np.allclose(dist_preds, preds, atol=1e-5)
+
+
+def test_save_and_load(tmp_path, rdd, toy_classification):
+    x, y = toy_classification
+    model = make_classifier()
+    spark_model = SparkModel(model, mode="synchronous", num_workers=4)
+    spark_model.fit(rdd, epochs=1, batch_size=16, validation_split=0.0)
+    path = str(tmp_path / "model.keras")
+    spark_model.save(path)
+    loaded = load_spark_model(path)
+    assert loaded.mode == "synchronous"
+    for a, b in zip(
+        spark_model.master_network.get_weights(), loaded.master_network.get_weights()
+    ):
+        assert np.allclose(a, b)
+    assert np.allclose(
+        loaded.master_network.predict(x[:4], verbose=0),
+        spark_model.predict(x[:4]),
+        atol=1e-5,
+    )

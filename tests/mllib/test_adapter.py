@@ -1,0 +1,42 @@
+"""MLlib linalg adapter round-trips (reference: tests/mllib/test_adapter.py)."""
+
+import numpy as np
+import pytest
+
+from elephas_tpu.mllib import (
+    DenseMatrix,
+    DenseVector,
+    from_matrix,
+    from_vector,
+    to_matrix,
+    to_vector,
+)
+
+
+def test_vector_round_trip():
+    v = np.array([1.0, -2.0, 3.5])
+    mv = to_vector(v)
+    assert isinstance(mv, DenseVector)
+    assert np.allclose(from_vector(mv), v)
+
+
+def test_matrix_round_trip():
+    m = np.arange(6, dtype="float64").reshape(2, 3)
+    mm = to_matrix(m)
+    assert isinstance(mm, DenseMatrix)
+    assert mm.numRows == 2 and mm.numCols == 3
+    assert np.allclose(from_matrix(mm), m)
+
+
+def test_matrix_column_major_storage():
+    m = np.array([[1.0, 2.0], [3.0, 4.0]])
+    mm = to_matrix(m)
+    # MLlib stores column-major
+    assert mm.values.tolist() == [1.0, 3.0, 2.0, 4.0]
+
+
+def test_shape_validation():
+    with pytest.raises(ValueError):
+        to_vector(np.zeros((2, 2)))
+    with pytest.raises(ValueError):
+        to_matrix(np.zeros(4))

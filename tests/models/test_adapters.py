@@ -1,0 +1,126 @@
+"""Keras↔JAX bridge tests: weight split/join, loss/optimizer mapping."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from elephas_tpu.models import KerasModelAdapter, resolve_per_sample_loss, to_optax
+
+
+def test_weights_state_round_trip(classifier_factory):
+    model = classifier_factory()
+    adapter = KerasModelAdapter(model)
+    flat = model.get_weights()
+    tv, ntv = adapter.weights_to_state(flat)
+    assert len(tv) == len(model.trainable_variables)
+    flat2 = adapter.state_to_weights(tv, ntv)
+    for a, b in zip(flat, flat2):
+        assert np.allclose(a, b)
+
+
+def test_adapter_requires_built_model():
+    import keras
+
+    model = keras.Sequential([keras.layers.Dense(2)])
+    with pytest.raises(ValueError):
+        KerasModelAdapter(model, loss="mse")
+
+
+def test_adapter_infers_accuracy(classifier_factory):
+    adapter = KerasModelAdapter(classifier_factory())
+    assert adapter.wants_accuracy
+
+
+def test_train_step_reduces_loss(classifier_factory, toy_classification):
+    x, y = toy_classification
+    adapter = KerasModelAdapter(classifier_factory())
+    opt = adapter.make_optimizer()
+    step = adapter.build_train_step(opt)
+    tv, ntv = adapter.state_values()
+    opt_state = opt.init(tv)
+    sw = np.ones((64,), "float32")
+    first_loss = None
+    for i in range(20):
+        tv, ntv, opt_state, (loss_ws, _, wsum) = step(
+            tv, ntv, opt_state, x[:64], y[:64], sw
+        )
+        if first_loss is None:
+            first_loss = float(loss_ws / wsum)
+    assert float(loss_ws / wsum) < first_loss
+
+
+def test_all_padding_batch_is_noop(classifier_factory, toy_classification):
+    """Zero sample-weight batches must not move params or optimizer state."""
+    x, y = toy_classification
+    adapter = KerasModelAdapter(classifier_factory())
+    opt = adapter.make_optimizer()
+    step = adapter.build_train_step(opt)
+    tv, ntv = adapter.state_values()
+    opt_state = opt.init(tv)
+    sw = np.zeros((32,), "float32")
+    tv2, ntv2, opt2, stats = step(tv, ntv, opt_state, x[:32], y[:32], sw)
+    for a, b in zip(tv, tv2):
+        assert np.allclose(a, b)
+
+
+@pytest.mark.parametrize(
+    "name", ["sgd", "adam", "rmsprop", "adagrad", "adamw", "nadam"]
+)
+def test_optimizer_mapping(name):
+    tx = to_optax(name)
+    params = [jnp.ones((3,))]
+    state = tx.init(params)
+    grads = [jnp.ones((3,))]
+    updates, _ = tx.update(grads, state, params)
+    assert updates[0].shape == (3,)
+
+
+def test_optimizer_from_keras_object():
+    import keras
+
+    tx = to_optax(keras.optimizers.Adam(learning_rate=0.01))
+    params = [jnp.zeros((2,))]
+    updates, _ = tx.update([jnp.ones((2,))], tx.init(params), params)
+    assert np.all(np.asarray(updates[0]) < 0)
+
+
+@pytest.mark.parametrize(
+    "loss,y_shape,out_shape",
+    [
+        ("mse", (8, 4), (8, 4)),
+        ("mae", (8, 4), (8, 4)),
+        ("categorical_crossentropy", (8, 5), (8, 5)),
+        ("binary_crossentropy", (8, 1), (8, 1)),
+        ("hinge", (8, 1), (8, 1)),
+    ],
+)
+def test_per_sample_losses_shapes(loss, y_shape, out_shape):
+    rng = np.random.default_rng(0)
+    y = rng.uniform(0.1, 0.9, size=y_shape).astype("float32")
+    p = rng.uniform(0.1, 0.9, size=out_shape).astype("float32")
+    fn = resolve_per_sample_loss(loss)
+    out = fn(y, p)
+    assert out.shape == (8,)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_sparse_categorical_loss():
+    fn = resolve_per_sample_loss("sparse_categorical_crossentropy")
+    y = np.array([0, 2], dtype="int32")
+    p = np.array([[0.8, 0.1, 0.1], [0.1, 0.1, 0.8]], dtype="float32")
+    out = np.asarray(fn(y, p))
+    assert out.shape == (2,)
+    assert np.allclose(out, -np.log(0.8), atol=1e-5)
+
+
+def test_loss_matches_keras_reference():
+    import keras
+
+    rng = np.random.default_rng(1)
+    y = np.eye(4, dtype="float32")[rng.integers(0, 4, size=16)]
+    p = rng.uniform(0.05, 0.95, size=(16, 4)).astype("float32")
+    p = p / p.sum(axis=1, keepdims=True)
+    ours = np.asarray(resolve_per_sample_loss("categorical_crossentropy")(y, p))
+    theirs = np.asarray(keras.losses.categorical_crossentropy(y, p))
+    assert np.allclose(ours, theirs, atol=1e-5)

@@ -1,0 +1,62 @@
+"""Pure-function weight-math tests (reference: tests/utils/test_functional_utils.py)."""
+
+import numpy as np
+
+from elephas_tpu.utils import (
+    add_params,
+    divide_by,
+    get_neutral,
+    mean_params,
+    scale_params,
+    subtract_params,
+)
+
+
+def _params():
+    return [np.array([[1.0, 2.0], [3.0, 4.0]]), np.array([0.5, -0.5])]
+
+
+def test_add_params():
+    p = _params()
+    out = add_params(p, p)
+    assert np.allclose(out[0], 2 * p[0])
+    assert np.allclose(out[1], 2 * p[1])
+
+
+def test_subtract_params_zero():
+    p = _params()
+    out = subtract_params(p, p)
+    for leaf in out:
+        assert np.allclose(leaf, 0)
+
+
+def test_delta_semantics():
+    """delta = before - after; applying via subtract recovers `after`."""
+    before = _params()
+    after = [leaf + 1.0 for leaf in before]
+    delta = subtract_params(before, after)
+    recovered = subtract_params(before, delta)
+    for r, a in zip(recovered, after):
+        assert np.allclose(r, a)
+
+
+def test_get_neutral():
+    p = _params()
+    z = get_neutral(p)
+    for zl, pl in zip(z, p):
+        assert zl.shape == pl.shape
+        assert np.allclose(zl, 0)
+
+
+def test_divide_by():
+    p = _params()
+    out = divide_by(p, 4)
+    assert np.allclose(out[0], p[0] / 4)
+
+
+def test_scale_and_mean():
+    p = _params()
+    assert np.allclose(scale_params(p, 2.0)[0], 2 * p[0])
+    q = [leaf * 3 for leaf in p]
+    m = mean_params([p, q])
+    assert np.allclose(m[0], 2 * p[0])

@@ -1,0 +1,64 @@
+"""RDD conversion tests (reference: tests/utils/test_rdd_utils.py)."""
+
+import numpy as np
+import pytest
+
+from elephas_tpu.mllib import LabeledPoint
+from elephas_tpu.utils import (
+    encode_label,
+    from_labeled_point,
+    lp_to_simple_rdd,
+    to_labeled_point,
+    to_simple_rdd,
+)
+
+
+def test_to_simple_rdd(spark_context):
+    x = np.arange(20).reshape(10, 2).astype("float32")
+    y = np.arange(10).astype("float32")
+    rdd = to_simple_rdd(spark_context, x, y)
+    pairs = rdd.collect()
+    assert len(pairs) == 10
+    assert np.allclose(pairs[3][0], x[3])
+    assert pairs[3][1] == y[3]
+
+
+def test_to_simple_rdd_length_mismatch(spark_context):
+    with pytest.raises(ValueError):
+        to_simple_rdd(spark_context, np.zeros((5, 2)), np.zeros((4,)))
+
+
+def test_encode_label():
+    enc = encode_label(2, 4)
+    assert enc.tolist() == [0, 0, 1, 0]
+
+
+def test_labeled_point_round_trip(spark_context):
+    x = np.random.default_rng(0).normal(size=(12, 3)).astype("float64")
+    y = np.array([0, 1, 2, 0, 1, 2, 0, 1, 2, 0, 1, 2], dtype="float64")
+    lp_rdd = to_labeled_point(spark_context, x, y, categorical=False)
+    points = lp_rdd.collect()
+    assert all(isinstance(p, LabeledPoint) for p in points)
+    x2, y2 = from_labeled_point(lp_rdd, categorical=False)
+    assert np.allclose(x2, x)
+    assert np.allclose(y2, y)
+
+
+def test_labeled_point_categorical(spark_context):
+    x = np.zeros((6, 2))
+    y_onehot = np.eye(3)[[0, 1, 2, 0, 1, 2]]
+    lp_rdd = to_labeled_point(spark_context, x, y_onehot, categorical=True)
+    labels = [p.label for p in lp_rdd.collect()]
+    assert labels == [0, 1, 2, 0, 1, 2]
+    _, y2 = from_labeled_point(lp_rdd, categorical=True, nb_classes=3)
+    assert np.allclose(y2, y_onehot)
+
+
+def test_lp_to_simple_rdd(spark_context):
+    x = np.ones((4, 2))
+    y = np.array([0, 1, 1, 0], dtype="float64")
+    lp_rdd = to_labeled_point(spark_context, x, y, categorical=False)
+    simple = lp_to_simple_rdd(lp_rdd, categorical=True, nb_classes=2)
+    pairs = simple.collect()
+    assert np.allclose(pairs[1][1], [0, 1])
+    assert np.allclose(pairs[0][0], x[0])

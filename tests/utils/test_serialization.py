@@ -1,0 +1,26 @@
+"""Model serialization round-trip (reference: tests/utils/test_serialization.py)."""
+
+import numpy as np
+
+from elephas_tpu.utils import dict_to_model, model_to_dict
+from elephas_tpu.utils.serialization import load_weights_npz, save_weights_npz
+
+
+def test_model_to_dict_round_trip(classifier_factory):
+    model = classifier_factory()
+    d = model_to_dict(model)
+    assert set(d.keys()) == {"model", "weights"}
+    model2 = dict_to_model(d)
+    for w1, w2 in zip(model.get_weights(), model2.get_weights()):
+        assert np.allclose(w1, w2)
+    x = np.random.default_rng(0).normal(size=(4, 10)).astype("float32")
+    assert np.allclose(model.predict(x, verbose=0), model2.predict(x, verbose=0))
+
+
+def test_weights_npz_round_trip(tmp_path, classifier_factory):
+    model = classifier_factory()
+    path = str(tmp_path / "weights.npz")
+    save_weights_npz(path, model.get_weights())
+    loaded = load_weights_npz(path)
+    for w1, w2 in zip(model.get_weights(), loaded):
+        assert np.allclose(w1, w2)

@@ -1,0 +1,46 @@
+"""Socket framing + master discovery (reference: elephas/utils/sockets.py)."""
+
+import os
+import socket
+import threading
+
+import numpy as np
+
+from elephas_tpu.utils.sockets import determine_master, receive, send
+
+
+def test_determine_master_env(monkeypatch):
+    monkeypatch.setenv("SPARK_LOCAL_IP", "10.1.2.3")
+    monkeypatch.delenv("ELEPHAS_MASTER", raising=False)
+    assert determine_master(4000) == "10.1.2.3:4000"
+    monkeypatch.setenv("ELEPHAS_MASTER", "tpu-host")
+    assert determine_master(4001) == "tpu-host:4001"
+    monkeypatch.setenv("ELEPHAS_MASTER", "tpu-host:9999")
+    assert determine_master(4001) == "tpu-host:9999"
+
+
+def test_send_receive_round_trip():
+    server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    server.bind(("127.0.0.1", 0))
+    server.listen(1)
+    port = server.getsockname()[1]
+    payload = {"weights": [np.arange(5), np.ones((2, 2))], "tag": "x"}
+    received = {}
+
+    def serve():
+        conn, _ = server.accept()
+        received["msg"] = receive(conn)
+        send(conn, "ack")
+        conn.close()
+
+    t = threading.Thread(target=serve)
+    t.start()
+    client = socket.create_connection(("127.0.0.1", port))
+    send(client, payload)
+    assert receive(client) == "ack"
+    t.join()
+    client.close()
+    server.close()
+    assert received["msg"]["tag"] == "x"
+    assert np.allclose(received["msg"]["weights"][0], np.arange(5))
