@@ -11,6 +11,23 @@ local facade: see :mod:`elephas_tpu.data`.
 
 __version__ = "0.1.0"
 
+from .hyperparam import HyperParamModel
+from .ml_model import (
+    ElephasEstimator,
+    ElephasTransformer,
+    load_ml_estimator,
+    load_ml_transformer,
+)
 from .spark_model import SparkMLlibModel, SparkModel, load_spark_model
 
-__all__ = ["SparkModel", "SparkMLlibModel", "load_spark_model", "__version__"]
+__all__ = [
+    "SparkModel",
+    "SparkMLlibModel",
+    "load_spark_model",
+    "ElephasEstimator",
+    "ElephasTransformer",
+    "load_ml_estimator",
+    "load_ml_transformer",
+    "HyperParamModel",
+    "__version__",
+]

@@ -64,6 +64,11 @@ class Row:
             and self._values == other._values
         )
 
+    def __hash__(self):
+        # pyspark Row is a tuple subclass: hashable when its values are
+        # (raises TypeError otherwise) — reproduce that contract.
+        return hash((tuple(self._fields), tuple(self._values)))
+
     def __repr__(self):
         kv = ", ".join(f"{f}={v!r}" for f, v in zip(self._fields, self._values))
         return f"Row({kv})"

@@ -1,0 +1,44 @@
+"""DL4J bridge — intentionally not ported.
+
+The reference's legacy path (``elephas/dl4j.py:~1`` ``ParameterAveragingModel``
+/ ``ParameterSharingModel`` + ``elephas/java/``) drives deeplearning4j's Spark
+training over pyjnius/JNI: Keras model → h5 → ``KerasModelImport`` →
+``SparkDl4jMultiLayer`` with a ``ParameterAveragingTrainingMaster`` or
+``SharedTrainingMaster`` (Aeron gradient sharing). SURVEY.md §2.5 marks it
+legacy/frozen and directs: do not port — the native TPU engine subsumes both
+training masters:
+
+- ``ParameterAveragingTrainingMaster`` ≡ ``SparkModel(mode='synchronous')``
+  (delta/parameter averaging over the mesh, ``elephas_tpu/parallel/engine.py``);
+- ``SharedTrainingMaster`` (asynchronous gradient sharing) ≡
+  ``SparkModel(mode='asynchronous'|'hogwild')``.
+
+These aliases exist so reference user code importing the DL4J names gets the
+equivalent TPU-native behavior instead of an ImportError, with a warning.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+from .spark_model import SparkModel
+
+
+def _deprecated(name: str, mode: str):
+    class _Alias(SparkModel):
+        def __init__(self, model, *args, **kwargs):
+            warnings.warn(
+                f"{name} is the legacy DL4J path; elephas_tpu subsumes it with "
+                f"SparkModel(mode='{mode}') on the TPU mesh.",
+                stacklevel=2,
+            )
+            kwargs.setdefault("mode", mode)
+            kwargs.pop("java_spark_context", None)
+            super().__init__(model, *args, **kwargs)
+
+    _Alias.__name__ = name
+    return _Alias
+
+
+ParameterAveragingModel = _deprecated("ParameterAveragingModel", "synchronous")
+ParameterSharingModel = _deprecated("ParameterSharingModel", "asynchronous")

@@ -1,0 +1,267 @@
+"""Distributed hyperparameter search: ``HyperParamModel``.
+
+Rebuild of reference ``elephas/hyperparam.py:~1`` (``HyperParamModel.minimize``
+/ ``compute_trials`` / ``best_models``, ``HyperasWorker._minimize``). The
+reference templates the *source code* of user-supplied ``data()``/``model()``
+functions hyperas-style — ``{{choice([...])}}`` markers inside the model
+function — fans the templated source out over a dummy RDD, and runs an
+independent hyperopt TPE search per partition with a partition-derived seed.
+
+hyperas/hyperopt are not in this environment (SURVEY.md §7.0), so the search
+core is self-contained but keeps the hyperas *user surface*:
+
+- write ``{{choice([...])}}`` / ``{{uniform(a, b)}}`` etc. in the model
+  function body (import the names from this module so the file parses);
+- ``data()`` returns ``x_train, y_train, x_test, y_test`` and is called on
+  every worker (the reference loads the dataset independently per worker —
+  search is parallel, data is not; SURVEY.md §3.5);
+- ``model(x_train, y_train, x_test, y_test)`` returns
+  ``{'loss': ..., 'status': STATUS_OK, 'model': model}``.
+
+Search strategy per worker: seeded random search with a successive-halving
+bias (second half of evals resamples near the best-so-far choice indices) —
+a TPE-lite stand-in; the reference's exact TPE is a documented divergence.
+"""
+
+from __future__ import annotations
+
+import inspect
+import random as _random
+import re
+import textwrap
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .data.rdd import SparkContext
+
+STATUS_OK = "ok"
+
+
+# -- hyperas-style distribution markers --------------------------------------
+# These exist so user files importing them parse; inside ``{{...}}`` they are
+# re-parsed textually into Space objects at template time.
+
+
+class _Space:
+    def sample(self, rng: _random.Random):
+        raise NotImplementedError
+
+
+class _Choice(_Space):
+    def __init__(self, options):
+        self.options = list(options)
+
+    def sample(self, rng):
+        return rng.choice(self.options)
+
+
+class _Uniform(_Space):
+    def __init__(self, low, high):
+        self.low, self.high = float(low), float(high)
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class _QUniform(_Space):
+    def __init__(self, low, high, q):
+        self.low, self.high, self.q = float(low), float(high), float(q)
+
+    def sample(self, rng):
+        return round(rng.uniform(self.low, self.high) / self.q) * self.q
+
+
+class _LogUniform(_Space):
+    def __init__(self, low, high):
+        import math
+
+        self.low, self.high = math.log(low), math.log(high)
+
+    def sample(self, rng):
+        import math
+
+        return math.exp(rng.uniform(self.low, self.high))
+
+
+def choice(options):  # noqa: D103 — hyperas-parity marker
+    return _Choice(options)
+
+
+def uniform(low, high):  # noqa: D103
+    return _Uniform(low, high)
+
+
+def quniform(low, high, q):  # noqa: D103
+    return _QUniform(low, high, q)
+
+
+def loguniform(low, high):  # noqa: D103
+    return _LogUniform(low, high)
+
+
+_MARKER = re.compile(r"\{\{(.+?)\}\}", re.DOTALL)
+
+
+def get_hyperopt_model_string(model_fn: Callable) -> Dict[str, Any]:
+    """Template the model function's source (reference: hyperas
+    ``get_hyperopt_model_string``, ``hyperparam.py:~30``).
+
+    Returns ``{'source', 'spaces', 'name'}`` where each ``{{...}}`` marker has
+    been replaced by ``__hp__[i]`` and ``spaces[i]`` is the parsed Space.
+    """
+    src = textwrap.dedent(inspect.getsource(model_fn))
+    # Drop decorators if any, keep the def.
+    spaces: List[_Space] = []
+
+    def repl(match):
+        expr = match.group(1)
+        space = eval(  # noqa: S307 — expression comes from the user's own file
+            expr,
+            {"choice": choice, "uniform": uniform, "quniform": quniform,
+             "loguniform": loguniform},
+        )
+        if not isinstance(space, _Space):
+            raise ValueError(f"{{{{{expr}}}}} is not a search-space expression")
+        spaces.append(space)
+        return f"__hp__[{len(spaces) - 1}]"
+
+    templated = _MARKER.sub(repl, src)
+    return {"source": templated, "spaces": spaces, "name": model_fn.__name__,
+            "globals": model_fn.__globals__}
+
+
+class HyperasWorker:
+    """Per-partition search worker (reference ``HyperasWorker._minimize``).
+
+    ``keep_weights_top`` bounds driver memory: only each worker's best-k
+    trials ship their full weight lists back; the rest carry
+    ``weights=None`` (loss/params always recorded).
+    """
+
+    def __init__(self, model_spec: Dict[str, Any], data_fn: Callable,
+                 max_evals: int, keep_weights_top: Optional[int] = None):
+        self.model_spec = model_spec
+        self.data_fn = data_fn
+        self.max_evals = int(max_evals)
+        self.keep_weights_top = keep_weights_top
+
+    def _minimize(self, data_iterator):
+        """Run ``max_evals`` evaluations seeded from the partition contents."""
+        elements = list(data_iterator)
+        seed = int(elements[0]) if elements else 0
+        rng = _random.Random(seed)
+        data = self.data_fn()
+
+        spaces = self.model_spec["spaces"]
+        exec_globals = dict(self.model_spec["globals"])
+        exec_globals["STATUS_OK"] = STATUS_OK
+        local_ns: Dict[str, Any] = {}
+        exec(compile(self.model_spec["source"], "<hyperparam-template>", "exec"),
+             exec_globals, local_ns)
+        fn = local_ns[self.model_spec["name"]]
+
+        trials: List[Dict[str, Any]] = []
+        best: Optional[Dict[str, Any]] = None
+        for i in range(self.max_evals):
+            if best is not None and i >= self.max_evals // 2:
+                # TPE-lite: exploit around the best sample's values
+                params = [
+                    b if rng.random() < 0.5 else s.sample(rng)
+                    for b, s in zip(best["params"], spaces)
+                ]
+            else:
+                params = [s.sample(rng) for s in spaces]
+            exec_globals["__hp__"] = params
+            result = fn(*data)
+            model = result["model"]
+            trial = {
+                "loss": float(result["loss"]),
+                "status": result.get("status", STATUS_OK),
+                "params": params,
+                "model_json": model.to_json(),
+                "weights": model.get_weights(),
+            }
+            trials.append(trial)
+            if best is None or trial["loss"] < best["loss"]:
+                best = trial
+        if self.keep_weights_top is not None:
+            ok = sorted(
+                (t for t in trials if t["status"] == STATUS_OK),
+                key=lambda t: t["loss"],
+            )
+            keep = {id(t) for t in ok[: self.keep_weights_top]}
+            for t in trials:
+                if id(t) not in keep:
+                    t["weights"] = None
+        yield trials
+
+
+class HyperParamModel:
+    """Driver-side distributed search (reference ``HyperParamModel``)."""
+
+    def __init__(self, sc: SparkContext, num_workers: int = 4):
+        self.spark_context = sc
+        self.num_workers = int(num_workers)
+
+    def compute_trials(self, model: Callable, data: Callable, max_evals: int,
+                       keep_weights_top: Optional[int] = None
+                       ) -> List[Dict[str, Any]]:
+        """All trials from all workers (reference ``compute_trials``)."""
+        model_spec = get_hyperopt_model_string(model)
+        worker = HyperasWorker(model_spec, data, max_evals, keep_weights_top)
+        # Dummy RDD fan-out: partition contents only seed the per-worker RNG
+        # (reference ``hyperparam.py:~40``).
+        dummy_rdd = self.spark_context.parallelize(range(1, 1000), 50)
+        dummy_rdd = dummy_rdd.repartition(self.num_workers)
+        trial_lists = dummy_rdd.mapPartitions(worker._minimize).collect()
+        return [t for trials in trial_lists for t in trials]
+
+    def minimize(self, model: Callable, data: Callable, max_evals: int = 5):
+        """Best Keras model across the distributed search
+        (reference ``minimize``)."""
+        import keras
+
+        trials = self.compute_trials(model, data, max_evals, keep_weights_top=1)
+        ok = [t for t in trials if t["status"] == STATUS_OK and t["weights"]]
+        if not ok:
+            raise ValueError("Search produced no successful trials")
+        best = min(ok, key=lambda t: t["loss"])
+        best_model = keras.models.model_from_json(best["model_json"])
+        best_model.set_weights(best["weights"])
+        return best_model
+
+    def best_models(self, nb_models: int, model: Callable, data: Callable,
+                    max_evals: int) -> "VotingModel":
+        """Top-k ensemble (reference ``best_models`` → hyperas VotingModel)."""
+        import keras
+
+        trials = self.compute_trials(
+            model, data, max_evals, keep_weights_top=nb_models
+        )
+        ok = sorted(
+            (t for t in trials if t["status"] == STATUS_OK and t["weights"]),
+            key=lambda t: t["loss"],
+        )
+        members = []
+        for t in ok[:nb_models]:
+            m = keras.models.model_from_json(t["model_json"])
+            m.set_weights(t["weights"])
+            members.append(m)
+        if not members:
+            raise ValueError("Search produced no successful trials")
+        return VotingModel(members)
+
+
+class VotingModel:
+    """Prediction-averaging ensemble (hyperas ``VotingModel`` parity)."""
+
+    def __init__(self, models: List):
+        self.models = list(models)
+
+    def predict(self, x, **kwargs):
+        preds = [m.predict(x, verbose=0) for m in self.models]
+        return np.mean(np.stack(preds), axis=0)
+
+    def predict_classes(self, x, **kwargs):
+        return self.predict(x).argmax(axis=-1)

@@ -1,0 +1,10 @@
+from .adapter import df_to_simple_rdd
+from .pipeline import Pipeline, PipelineModel, StandardScaler, StringIndexer
+
+__all__ = [
+    "df_to_simple_rdd",
+    "Pipeline",
+    "PipelineModel",
+    "StandardScaler",
+    "StringIndexer",
+]

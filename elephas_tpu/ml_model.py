@@ -1,0 +1,273 @@
+"""Spark-ML-pipeline skin: ``ElephasEstimator`` / ``ElephasTransformer``.
+
+Rebuild of reference ``elephas/ml_model.py:~1``: an Estimator configured with
+~15 ``Has*`` param mixins (``elephas/ml/params.py``), whose ``_fit(df)``
+converts the DataFrame to a simple RDD, rebuilds+compiles the Keras model from
+its serialized config, trains through :class:`~elephas_tpu.spark_model.SparkModel`,
+and returns a Transformer carrying the trained config+weights that appends a
+prediction column on ``_transform``.
+
+Reference behaviors kept: the transformer predicts with the trained master
+network and appends ``output_col`` cast to float (argmax class index for
+categorical models — upstream collects features to the driver and the
+prediction itself runs on the accelerator; under Keras-3/JAX that is the TPU);
+estimator/transformer persistence is an HDF5 file whose attributes carry the
+param blob (``ml_model.py:~20,~220``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .data.dataframe import DataFrame, Row
+from .ml.adapter import df_to_simple_rdd
+from .ml.params import (
+    HasBatchSize,
+    HasCategoricalLabels,
+    HasCustomObjects,
+    HasEpochs,
+    HasFeaturesCol,
+    HasFrequency,
+    HasKerasModelConfig,
+    HasLabelCol,
+    HasLoss,
+    HasMetrics,
+    HasMode,
+    HasNumberOfClasses,
+    HasNumberOfWorkers,
+    HasOptimizerConfig,
+    HasOutputCol,
+    HasParameterServerMode,
+    HasValidationSplit,
+    HasVerbosity,
+    Params,
+)
+from .spark_model import SparkModel
+
+
+class _Estimator:
+    """pyspark ``Estimator`` shape: public ``fit`` delegates to ``_fit``.
+
+    ``params`` apply to a copy (pyspark semantics) — the estimator itself is
+    not mutated."""
+
+    def fit(self, df: DataFrame, params: Optional[dict] = None):
+        if params:
+            return self.copy(params)._fit(df)
+        return self._fit(df)
+
+
+class _Transformer:
+    """pyspark ``Transformer`` shape: public ``transform`` → ``_transform``."""
+
+    def transform(self, df: DataFrame, params: Optional[dict] = None):
+        if params:
+            return self.copy(params)._transform(df)
+        return self._transform(df)
+
+
+class ElephasEstimator(
+    Params, _Estimator,
+    HasKerasModelConfig, HasOptimizerConfig, HasMode, HasFrequency,
+    HasParameterServerMode, HasNumberOfClasses, HasNumberOfWorkers, HasEpochs,
+    HasBatchSize, HasVerbosity, HasValidationSplit, HasCategoricalLabels,
+    HasLoss, HasMetrics, HasFeaturesCol, HasLabelCol, HasOutputCol,
+    HasCustomObjects,
+):
+    """Trains a Keras model on a DataFrame inside an ML ``Pipeline``."""
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        if kwargs:
+            self.setParams(**kwargs)
+
+    def set_keras_model(self, model) -> "ElephasEstimator":
+        """Convenience: capture config + optimizer/loss from a compiled model."""
+        import keras
+
+        self.set_keras_model_config(model.to_json())
+        if getattr(model, "optimizer", None) is not None:
+            self.set_optimizer_config(keras.optimizers.serialize(model.optimizer))
+        if getattr(model, "loss", None) is not None and self.get_loss() is None:
+            loss = model.loss
+            self.set_loss(loss if isinstance(loss, str) else keras.losses.serialize(loss))
+        return self
+
+    def _fit(self, df: DataFrame) -> "ElephasTransformer":
+        import keras
+
+        simple_rdd = df_to_simple_rdd(
+            df,
+            categorical=self.get_categorical(),
+            nb_classes=self.get_nb_classes() if self.get_categorical() else None,
+            features_col=self.get_features_col(),
+            label_col=self.get_label_col(),
+        )
+        model = keras.models.model_from_json(
+            self.get_keras_model_config(), custom_objects=self.get_custom_objects()
+        )
+        optimizer_config = self.get_optimizer_config()
+        optimizer = (
+            keras.optimizers.deserialize(dict(optimizer_config))
+            if isinstance(optimizer_config, dict)
+            else (optimizer_config or "sgd")
+        )
+        loss = self.get_loss()
+        if loss is None:
+            raise ValueError("ElephasEstimator requires loss (set_loss or loss=)")
+        if isinstance(loss, dict):
+            loss = keras.losses.deserialize(loss)
+        model.compile(optimizer=optimizer, loss=loss,
+                      metrics=list(self.get_metrics() or []))
+
+        spark_model = SparkModel(
+            model,
+            mode=self.get_mode(),
+            frequency=self.get_frequency(),
+            parameter_server_mode=self.get_parameter_server_mode(),
+            num_workers=self.get_num_workers(),
+            custom_objects=self.get_custom_objects(),
+            batch_size=self.get_batch_size(),
+        )
+        spark_model.fit(
+            simple_rdd,
+            epochs=self.get_epochs(),
+            batch_size=self.get_batch_size(),
+            verbose=self.get_verbose(),
+            validation_split=self.get_validation_split(),
+        )
+        return ElephasTransformer(
+            keras_model_config=spark_model.master_network.to_json(),
+            weights=spark_model.master_network.get_weights(),
+            categorical=self.get_categorical(),
+            features_col=self.get_features_col(),
+            label_col=self.get_label_col(),
+            output_col=self.get_output_col(),
+            custom_objects=self.get_custom_objects(),
+            loss=self.get_loss() if isinstance(self.get_loss(), str) else None,
+        )
+
+    def save(self, path: str) -> None:
+        _save_params_h5(path, "estimator", self.param_values())
+
+
+class ElephasTransformer(
+    Params, _Transformer,
+    HasKerasModelConfig, HasCategoricalLabels, HasFeaturesCol, HasLabelCol,
+    HasOutputCol, HasCustomObjects, HasLoss,
+):
+    """Carries a trained model; appends predictions to DataFrames."""
+
+    def __init__(self, weights=None, **kwargs):
+        super().__init__()
+        if kwargs:
+            self.setParams(**kwargs)
+        self.weights = [np.asarray(w) for w in (weights or [])]
+        self._model = None
+
+    def get_model(self):
+        """The trained Keras model (rebuilt lazily)."""
+        if self._model is None:
+            import keras
+
+            self._model = keras.models.model_from_json(
+                self.get_keras_model_config(),
+                custom_objects=self.get_custom_objects(),
+            )
+            if self.weights:
+                self._model.set_weights(self.weights)
+        return self._model
+
+    def _transform(self, df: DataFrame) -> DataFrame:
+        """Append ``output_col`` with model predictions.
+
+        Features are collected to dense arrays, predicted in one accelerator
+        batch (reference upstream behavior — ``ml_model.py:~150``), and zipped
+        back as a new column.
+        """
+        from .ml.adapter import _to_array
+
+        model = self.get_model()
+        features_col = self.get_features_col()
+        output_col = self.get_output_col()
+        rows = df.collect()
+        features = np.stack([_to_array(r[features_col]) for r in rows])
+        predictions = model.predict(features, verbose=0)
+        if self.get_categorical() and predictions.ndim > 1 and predictions.shape[-1] > 1:
+            values = predictions.argmax(axis=-1).astype("float64")
+        else:
+            values = predictions.reshape(len(rows), -1)[:, 0].astype("float64")
+        new_rows = []
+        for r, v in zip(rows, values):
+            d = r.asDict()
+            d[output_col] = float(v)
+            new_rows.append(Row(**d))
+        columns = df.columns + ([output_col] if output_col not in df.columns else [])
+        sc = df.rdd.context
+        return DataFrame(sc.parallelize(new_rows, df.rdd.getNumPartitions()), columns)
+
+    def save(self, path: str) -> None:
+        _save_params_h5(path, "transformer", self.param_values(), self.weights)
+
+
+# -- persistence (reference: HDF5 attribute blob, ml_model.py:~20) -----------
+
+
+def _save_params_h5(path: str, kind: str, params: Dict[str, Any], weights=None):
+    import h5py
+
+    clean = {
+        k: v for k, v in params.items()
+        if not callable(v) and k != "custom_objects"
+    }
+    with h5py.File(path, "w") as f:
+        f.attrs["elephas_kind"] = kind
+        f.attrs["params_json"] = json.dumps(clean)
+        if weights:
+            grp = f.create_group("weights")
+            for i, w in enumerate(weights):
+                grp.create_dataset(f"w{i}", data=np.asarray(w))
+
+
+def _load_params_h5(path: str):
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        kind = f.attrs["elephas_kind"]
+        params = json.loads(f.attrs["params_json"])
+        weights = None
+        if "weights" in f:
+            grp = f["weights"]
+            weights = [np.array(grp[f"w{i}"]) for i in range(len(grp.keys()))]
+    return kind, params, weights
+
+
+def load_ml_estimator(path: str,
+                      custom_objects: Optional[dict] = None) -> ElephasEstimator:
+    """Reference ``load_ml_estimator`` (``ml_model.py:~220``).
+
+    ``custom_objects`` cannot be serialized into the h5 blob (they are live
+    Python objects) — resupply them here when the model uses custom layers.
+    """
+    kind, params, _ = _load_params_h5(path)
+    if kind != "estimator":
+        raise ValueError(f"{path} holds a {kind}, not an estimator")
+    est = ElephasEstimator(**params)
+    if custom_objects is not None:
+        est.set_custom_objects(custom_objects)
+    return est
+
+
+def load_ml_transformer(path: str,
+                        custom_objects: Optional[dict] = None) -> ElephasTransformer:
+    """Reference ``load_ml_transformer`` (``ml_model.py:~230``)."""
+    kind, params, weights = _load_params_h5(path)
+    if kind != "transformer":
+        raise ValueError(f"{path} holds a {kind}, not a transformer")
+    tr = ElephasTransformer(weights=weights, **params)
+    if custom_objects is not None:
+        tr.set_custom_objects(custom_objects)
+    return tr

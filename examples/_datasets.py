@@ -1,0 +1,95 @@
+"""Example datasets with offline synthetic fallbacks.
+
+``keras.datasets.*`` downloads are unavailable in air-gapped environments, so
+every loader falls back to a deterministic synthetic dataset with the same
+shapes/dtypes as the real one. The training dynamics differ from the real
+datasets, but every example exercises the identical API surface and shapes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def load_mnist(n_train=16384, n_test=2048):
+    """(x [n,784] float32 in [0,1], y one-hot [n,10]) — real MNIST if cached."""
+    try:
+        import keras
+
+        (x_tr, y_tr), (x_te, y_te) = keras.datasets.mnist.load_data()
+        x_tr = x_tr.reshape(-1, 784).astype("float32") / 255.0
+        x_te = x_te.reshape(-1, 784).astype("float32") / 255.0
+        y_tr = np.eye(10, dtype="float32")[y_tr]
+        y_te = np.eye(10, dtype="float32")[y_te]
+        return (x_tr[:n_train], y_tr[:n_train]), (x_te[:n_test], y_te[:n_test])
+    except Exception:
+        rng = np.random.default_rng(0)
+        # Class-dependent Gaussian blobs in pixel space: learnable, MNIST-shaped.
+        protos = rng.uniform(0, 1, size=(10, 784)).astype("float32")
+
+        def make(n):
+            labels = rng.integers(0, 10, size=n)
+            x = protos[labels] + 0.3 * rng.normal(size=(n, 784)).astype("float32")
+            x = np.clip(x, 0, 1).astype("float32")
+            y = np.eye(10, dtype="float32")[labels]
+            return x, y
+
+        return make(n_train), make(n_test)
+
+
+def load_imdb(n_train=2048, n_test=512, maxlen=80, vocab=2000):
+    """(sequences [n,maxlen] int32, labels [n,1] float32) — IMDB-shaped."""
+    try:
+        import keras
+
+        (x_tr, y_tr), (x_te, y_te) = keras.datasets.imdb.load_data(num_words=vocab)
+        from keras.preprocessing.sequence import pad_sequences
+
+        x_tr = pad_sequences(x_tr, maxlen=maxlen).astype("int32")
+        x_te = pad_sequences(x_te, maxlen=maxlen).astype("int32")
+        return (
+            (x_tr[:n_train], y_tr[:n_train].astype("float32").reshape(-1, 1)),
+            (x_te[:n_test], y_te[:n_test].astype("float32").reshape(-1, 1)),
+        )
+    except Exception:
+        rng = np.random.default_rng(1)
+        # Sentiment-word model: two token distributions; label = which
+        # distribution dominated the sequence.
+        pos_words = rng.integers(2, vocab // 2, size=vocab // 8)
+        neg_words = rng.integers(vocab // 2, vocab, size=vocab // 8)
+
+        def make(n):
+            labels = rng.integers(0, 2, size=n)
+            seqs = np.where(
+                labels[:, None] == 1,
+                rng.choice(pos_words, size=(n, maxlen)),
+                rng.choice(neg_words, size=(n, maxlen)),
+            )
+            noise = rng.integers(2, vocab, size=(n, maxlen))
+            mask = rng.random((n, maxlen)) < 0.3
+            seqs = np.where(mask, noise, seqs).astype("int32")
+            return seqs, labels.astype("float32").reshape(-1, 1)
+
+        return make(n_train), make(n_test)
+
+
+def load_boston(n=506):
+    """Boston-housing-shaped regression: (x [n,13], y [n])."""
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(n, 13)).astype("float32")
+    w = rng.normal(size=(13,))
+    y = (x @ w + 0.1 * rng.normal(size=n) + 22.5).astype("float32")
+    return x, y
+
+
+def load_iris():
+    """Iris-shaped 3-class problem: (x [150,4], y [150] class ids)."""
+    rng = np.random.default_rng(3)
+    centers = np.array(
+        [[5.0, 3.4, 1.5, 0.2], [5.9, 2.8, 4.3, 1.3], [6.6, 3.0, 5.6, 2.0]],
+        dtype="float32",
+    )
+    labels = np.repeat(np.arange(3), 50)
+    x = centers[labels] + 0.25 * rng.normal(size=(150, 4)).astype("float32")
+    perm = rng.permutation(150)
+    return x[perm].astype("float32"), labels[perm].astype("float64")

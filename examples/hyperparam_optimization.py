@@ -1,0 +1,58 @@
+"""Config 5: distributed hyperparameter search with ``HyperParamModel``.
+
+The reference's ``examples/hyperparam_optimization.py`` equivalent: hyperas
+``{{choice(...)}}`` template markers in the model source, fanned out over
+workers, best model reconstructed on the driver.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from elephas_tpu import HyperParamModel
+from elephas_tpu.data import SparkContext
+from elephas_tpu.hyperparam import STATUS_OK, choice, uniform
+
+from _datasets import load_mnist  # noqa: E402
+
+
+def data():
+    (x_train, y_train), (x_test, y_test) = load_mnist(n_train=4096, n_test=1024)
+    return x_train, y_train, x_test, y_test
+
+
+def model(x_train, y_train, x_test, y_test):
+    import keras
+
+    m = keras.Sequential(
+        [
+            keras.layers.Dense({{choice([64, 128, 256])}}, activation="relu"),
+            keras.layers.Dropout({{uniform(0.0, 0.5)}}),
+            keras.layers.Dense(10, activation="softmax"),
+        ]
+    )
+    m.build((None, 784))
+    m.compile(optimizer="adam", loss="categorical_crossentropy",
+              metrics=["accuracy"])
+    m.fit(x_train, y_train, epochs=2, batch_size=128, verbose=0)
+    loss, acc = m.evaluate(x_test, y_test, verbose=0)
+    return {"loss": -acc, "status": STATUS_OK, "model": m}
+
+
+def main():
+    sc = SparkContext(master="local[4]", appName="hyperparam")
+    hp = HyperParamModel(sc, num_workers=4)
+    best = hp.minimize(model=model, data=data, max_evals=3)
+    x_tr, y_tr, x_te, y_te = data()
+    preds = best.predict(x_te, verbose=0)
+    acc = float((preds.argmax(1) == y_te.argmax(1)).mean())
+    print(f"best model test accuracy: {acc:.4f}")
+    sc.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,80 @@
+"""Config 4: ``SparkMLlibModel`` on LabeledPoint RDDs.
+
+Boston-housing-shaped regression + Iris multiclass, the reference's
+``examples/mllib_mlp.py`` equivalents: LabeledPoint in, MLlib Vector/Matrix
+out.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import keras
+import numpy as np
+
+from elephas_tpu import SparkMLlibModel
+from elephas_tpu.data import SparkContext
+from elephas_tpu.mllib import Matrices, Vectors
+from elephas_tpu.utils import to_labeled_point
+
+from _datasets import load_boston, load_iris  # noqa: E402
+
+
+def boston_regression(sc, n_workers):
+    x, y = load_boston()
+    # standardize for a stable MLP fit
+    x = (x - x.mean(0)) / (x.std(0) + 1e-6)
+    y_mean, y_std = y.mean(), y.std()
+    lp_rdd = to_labeled_point(sc, x, (y - y_mean) / y_std, categorical=False)
+
+    model = keras.Sequential(
+        [keras.layers.Dense(32, activation="relu"), keras.layers.Dense(1)]
+    )
+    model.build((None, 13))
+    model.compile(optimizer="adam", loss="mse")
+    mllib_model = SparkMLlibModel(model, mode="synchronous",
+                                  num_workers=n_workers)
+    mllib_model.fit(lp_rdd, epochs=20, batch_size=32, validation_split=0.0,
+                    categorical=False)
+    pred = mllib_model.predict(Vectors.dense(x[0].astype("float64")))
+    print(f"Boston: predicted {float(pred[0]) * y_std + y_mean:.1f}, "
+          f"actual {y[0]:.1f}")
+
+
+def iris_classification(sc, n_workers):
+    x, y = load_iris()
+    x = (x - x.mean(0)) / (x.std(0) + 1e-6)
+    lp_rdd = to_labeled_point(sc, x, y, categorical=True)
+
+    model = keras.Sequential(
+        [keras.layers.Dense(16, activation="relu"),
+         keras.layers.Dense(3, activation="softmax")]
+    )
+    model.build((None, 4))
+    model.compile(optimizer="adam", loss="categorical_crossentropy",
+                  metrics=["accuracy"])
+    mllib_model = SparkMLlibModel(model, mode="synchronous",
+                                  num_workers=min(n_workers, 4))
+    mllib_model.fit(lp_rdd, epochs=30, batch_size=16, validation_split=0.0,
+                    categorical=True, nb_classes=3)
+    preds = mllib_model.predict(
+        Matrices.dense(len(x), 4, x.astype("float64").flatten(order="F"))
+    )
+    acc = float((preds.toArray().argmax(1) == y).mean())
+    print(f"Iris: train accuracy {acc:.4f}")
+
+
+def main():
+    import jax
+
+    n_workers = jax.local_device_count()
+    sc = SparkContext(master=f"local[{n_workers}]", appName="mllib_mlp")
+    boston_regression(sc, n_workers)
+    iris_classification(sc, n_workers)
+    sc.stop()
+
+
+if __name__ == "__main__":
+    main()

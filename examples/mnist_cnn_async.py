@@ -1,0 +1,66 @@
+"""Config 2: MNIST CNN, asynchronous + hogwild modes.
+
+The reference drives these through its HTTP/Socket parameter server; here both
+the literal host PS (``parameter_server_mode='http'|'socket'``) and the
+on-device merge path (``'jax'``) are exercised. The CNN (Conv2D stack) runs
+on the MXU via XLA.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import keras
+
+from elephas_tpu import SparkModel
+from elephas_tpu.data import SparkContext
+from elephas_tpu.utils import to_simple_rdd
+
+from _datasets import load_mnist  # noqa: E402
+
+
+def make_cnn():
+    model = keras.Sequential(
+        [
+            keras.layers.Reshape((28, 28, 1)),
+            keras.layers.Conv2D(16, 3, activation="relu"),
+            keras.layers.MaxPooling2D(2),
+            keras.layers.Conv2D(32, 3, activation="relu"),
+            keras.layers.MaxPooling2D(2),
+            keras.layers.Flatten(),
+            keras.layers.Dense(64, activation="relu"),
+            keras.layers.Dense(10, activation="softmax"),
+        ]
+    )
+    model.build((None, 784))
+    model.compile(optimizer="adam", loss="categorical_crossentropy",
+                  metrics=["accuracy"])
+    return model
+
+
+def main():
+    import jax
+
+    n_workers = jax.local_device_count()
+    sc = SparkContext(master=f"local[{n_workers}]", appName="mnist_cnn_async")
+    (x_train, y_train), (x_test, y_test) = load_mnist(n_train=8192, n_test=1024)
+    rdd = to_simple_rdd(sc, x_train, y_train)
+
+    for mode, ps in [("asynchronous", "jax"), ("hogwild", "jax"),
+                     ("asynchronous", "http")]:
+        model = make_cnn()
+        spark_model = SparkModel(
+            model, mode=mode, frequency="epoch", parameter_server_mode=ps,
+            num_workers=n_workers, port=4100, merge="mean",
+        )
+        spark_model.fit(rdd, epochs=3, batch_size=64, verbose=0,
+                        validation_split=0.0)
+        loss, acc = spark_model.evaluate(x_test, y_test)
+        print(f"{mode:12s}/{ps:6s}: test loss={loss:.4f} acc={acc:.4f}")
+    sc.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Distributed hyperparameter search tests (reference: tests/test_hyperparam.py)."""
+
+import numpy as np
+
+from elephas_tpu import HyperParamModel
+from elephas_tpu.hyperparam import STATUS_OK, VotingModel, choice, uniform
+
+
+def data():
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(256, 8)).astype("float32")
+    w = rng.normal(size=(8, 2))
+    y = np.eye(2, dtype="float32")[(x @ w).argmax(1)]
+    return x[:192], y[:192], x[192:], y[192:]
+
+
+def model(x_train, y_train, x_test, y_test):
+    import keras
+
+    m = keras.Sequential(
+        [
+            keras.layers.Dense({{choice([8, 16, 32])}}, activation="relu"),
+            keras.layers.Dropout({{uniform(0.0, 0.3)}}),
+            keras.layers.Dense(2, activation="softmax"),
+        ]
+    )
+    m.build((None, 8))
+    m.compile(optimizer="adam", loss="categorical_crossentropy",
+              metrics=["accuracy"])
+    m.fit(x_train, y_train, epochs=3, batch_size=32, verbose=0)
+    loss, acc = m.evaluate(x_test, y_test, verbose=0)
+    return {"loss": -acc, "status": STATUS_OK, "model": m}
+
+
+def test_minimize_returns_trained_model(spark_context):
+    hp = HyperParamModel(spark_context, num_workers=2)
+    best = hp.minimize(model=model, data=data, max_evals=2)
+    x_tr, y_tr, x_te, y_te = data()
+    preds = best.predict(x_te, verbose=0)
+    acc = float((preds.argmax(1) == y_te.argmax(1)).mean())
+    assert acc > 0.5, f"best model accuracy too low: {acc}"
+
+
+def test_compute_trials_counts(spark_context):
+    hp = HyperParamModel(spark_context, num_workers=2)
+    trials = hp.compute_trials(model=model, data=data, max_evals=2)
+    assert len(trials) == 4  # num_workers * max_evals
+    assert all(t["status"] == STATUS_OK for t in trials)
+    # sampled hyperparameters recorded, within their spaces
+    for t in trials:
+        assert t["params"][0] in (8, 16, 32)
+        assert 0.0 <= t["params"][1] <= 0.3
+
+
+def test_voting_model(spark_context):
+    hp = HyperParamModel(spark_context, num_workers=2)
+    ensemble = hp.best_models(nb_models=2, model=model, data=data, max_evals=2)
+    assert isinstance(ensemble, VotingModel)
+    x_tr, y_tr, x_te, y_te = data()
+    preds = ensemble.predict(x_te)
+    assert preds.shape == (64, 2)
+    classes = ensemble.predict_classes(x_te)
+    assert set(np.unique(classes)).issubset({0, 1})

@@ -1,0 +1,125 @@
+"""ElephasEstimator/Transformer pipeline tests (reference: tests/test_ml_model.py)."""
+
+import numpy as np
+import pytest
+
+from elephas_tpu import (
+    ElephasEstimator,
+    ElephasTransformer,
+    load_ml_estimator,
+    load_ml_transformer,
+)
+from elephas_tpu.data import Row
+from elephas_tpu.ml import Pipeline, StandardScaler, StringIndexer, df_to_simple_rdd
+from elephas_tpu.mllib import Vectors
+
+from ..conftest import make_classifier
+
+
+@pytest.fixture
+def df(spark_session, toy_classification):
+    x, y = toy_classification
+    rows = [
+        Row(features=Vectors.dense(xi.astype("float64")), label=float(yi.argmax()))
+        for xi, yi in zip(x, y)
+    ]
+    return spark_session.createDataFrame(rows)
+
+
+def make_estimator(num_workers=4, epochs=3):
+    import keras
+
+    model = make_classifier()
+    est = ElephasEstimator()
+    est.set_keras_model_config(model.to_json())
+    est.set_optimizer_config(keras.optimizers.serialize(keras.optimizers.Adam()))
+    est.set_loss("categorical_crossentropy")
+    est.set_metrics(["accuracy"])
+    est.set_categorical(True)
+    est.set_nb_classes(3)
+    est.set_num_workers(num_workers)
+    est.set_epochs(epochs)
+    est.set_batch_size(16)
+    est.set_validation_split(0.0)
+    est.set_mode("synchronous")
+    est.set_parameter_server_mode("jax")
+    return est
+
+
+def test_df_to_simple_rdd(df):
+    rdd = df_to_simple_rdd(df, categorical=True, nb_classes=3)
+    x0, y0 = rdd.first()
+    assert x0.shape == (10,)
+    assert y0.shape == (3,)
+    assert y0.sum() == 1.0
+
+
+def test_estimator_fit_transform(df, toy_classification):
+    x, y = toy_classification
+    est = make_estimator()
+    transformer = est.fit(df)
+    assert isinstance(transformer, ElephasTransformer)
+    out = transformer.transform(df)
+    assert "prediction" in out.columns
+    preds = np.array([r.prediction for r in out.collect()])
+    labels = np.array([r.label for r in out.collect()])
+    acc = float((preds == labels).mean())
+    assert acc > 0.34, f"pipeline accuracy too low: {acc}"
+    assert preds.dtype == np.float64
+
+
+def test_pipeline_with_feature_stages(spark_session, toy_classification):
+    x, y = toy_classification
+    rows = [
+        Row(raw=Vectors.dense(xi.astype("float64")),
+            category=["a", "b", "c"][int(yi.argmax())])
+        for xi, yi in zip(x, y)
+    ]
+    df = spark_session.createDataFrame(rows)
+    est = make_estimator(epochs=3)
+    est.set_features_col("scaled")
+    est.set_label_col("label")
+    pipeline = Pipeline(
+        stages=[
+            StringIndexer(inputCol="category", outputCol="label"),
+            StandardScaler(inputCol="raw", outputCol="scaled"),
+            est,
+        ]
+    )
+    fitted = pipeline.fit(df)
+    out = fitted.transform(df)
+    assert "prediction" in out.columns
+    assert out.count() == len(rows)
+
+
+def test_estimator_save_load(tmp_path):
+    est = make_estimator()
+    path = str(tmp_path / "estimator.h5")
+    est.save(path)
+    loaded = load_ml_estimator(path)
+    assert loaded.get_mode() == "synchronous"
+    assert loaded.get_nb_classes() == 3
+    assert loaded.get_keras_model_config() == est.get_keras_model_config()
+
+
+def test_transformer_save_load(tmp_path, df, toy_classification):
+    x, _ = toy_classification
+    transformer = make_estimator(epochs=1).fit(df)
+    path = str(tmp_path / "transformer.h5")
+    transformer.save(path)
+    loaded = load_ml_transformer(path)
+    preds1 = loaded.get_model().predict(x[:4].astype("float32"), verbose=0)
+    preds2 = transformer.get_model().predict(x[:4].astype("float32"), verbose=0)
+    assert np.allclose(preds1, preds2, atol=1e-6)
+
+
+def test_explain_params():
+    est = make_estimator()
+    text = est.explainParams()
+    assert "keras_model_config" in text
+    assert "num_workers" in text
+
+
+def test_unknown_param_rejected():
+    with pytest.raises(ValueError, match="Unknown param"):
+        ElephasEstimator(not_a_param=1)
