@@ -1,0 +1,56 @@
+"""Multi-host bootstrap: the TPU-native ``determine_master``.
+
+In the reference, executors locate the driver's parameter server through
+``determine_master`` (``elephas/utils/sockets.py:~10`` — ``SPARK_LOCAL_IP``
+or resolved hostname, baked into the worker closure; SURVEY.md §2.4). On a
+TPU pod the equivalent bring-up is ``jax.distributed.initialize``: every host
+process dials the coordinator over DCN, after which ``jax.devices()`` spans
+the pod and the SAME 1-D ``"data"`` mesh (and the same compiled training
+program) covers all hosts — merge collectives ride ICI within a slice and
+DCN across slices, chosen by XLA.
+
+Single-host (this machine) is the degenerate case: calling
+:func:`initialize_cluster` with ``num_processes=1`` (or not at all) changes
+nothing, so all code paths are identical between 1 chip and a v5e-256 pod.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..utils.sockets import determine_master
+
+
+def initialize_cluster(coordinator_address: Optional[str] = None,
+                       num_processes: Optional[int] = None,
+                       process_id: Optional[int] = None,
+                       port: int = 8476) -> None:
+    """Join (or trivially skip) the multi-host JAX cluster.
+
+    Resolution order for the coordinator mirrors the reference's master
+    discovery: explicit argument > ``ELEPHAS_MASTER``/``SPARK_LOCAL_IP`` env
+    (via :func:`determine_master`) > single-process no-op.
+    """
+    import jax
+
+    if num_processes is None:
+        num_processes = int(os.environ.get("ELEPHAS_NUM_PROCESSES", "1"))
+    if num_processes <= 1:
+        return  # single host: nothing to initialize
+    if process_id is None:
+        process_id = int(os.environ.get("ELEPHAS_PROCESS_ID", "0"))
+    if coordinator_address is None:
+        coordinator_address = determine_master(port)
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh(axis_name: str = "data"):
+    """A 1-D mesh over every device in the (possibly multi-host) cluster."""
+    from .mesh import build_mesh
+
+    return build_mesh(axis_name=axis_name)

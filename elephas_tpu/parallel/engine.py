@@ -43,6 +43,7 @@ computes.
 from __future__ import annotations
 
 import math
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -66,11 +67,14 @@ def _pad_block(arr: np.ndarray, target_rows: int) -> np.ndarray:
 
 
 class FitResult:
-    """Final weights + Keras-``History``-shaped metrics."""
+    """Final weights + Keras-``History``-shaped metrics (+ carryable state)."""
 
-    def __init__(self, weights: List[np.ndarray], history: Dict[str, List[float]]):
+    def __init__(self, weights: List[np.ndarray], history: Dict[str, List[float]],
+                 opt_state: Any = None, timings: Optional[Dict[str, float]] = None):
         self.weights = weights
         self.history = history
+        self.opt_state = opt_state
+        self.timings = timings or {}
 
 
 class CompiledTrainer:
@@ -103,11 +107,17 @@ class CompiledTrainer:
     # ------------------------------------------------------------------
     def fit(self, blocks: Sequence[Tuple[np.ndarray, np.ndarray]], epochs: int,
             batch_size: int, validation_split: float = 0.0,
-            seed: int = 0, verbose: int = 0) -> FitResult:
+            seed: int = 0, verbose: int = 0, opt_state: Any = None,
+            keep_opt_state: bool = False) -> FitResult:
         """Train over per-worker data ``blocks`` ``[(x_w, y_w), ...]``.
 
         Returns merged weights in ``get_weights()`` order plus per-epoch
         history (``loss``[, ``accuracy``, ``val_loss``, ``val_accuracy``]).
+
+        Optimizer state is an explicit input/output of the compiled program:
+        pass ``opt_state`` from a previous ``FitResult`` to continue training
+        (checkpoint/resume, epoch-chunked fits) instead of cold-starting the
+        optimizer; ``keep_opt_state=True`` returns it on the result.
         """
         W = len(blocks)
         if W == 0:
@@ -176,11 +186,16 @@ class CompiledTrainer:
             self._cache[sig] = self._build(
                 L=L, S=S, B=B, E=E, Sv=Sv, has_val=has_val, mergeable=mergeable
             )
-        fit_fn = self._cache[sig]
+        fit_fn, opt_init_fn = self._cache[sig]
 
-        tv_out, ntv_out, metrics = fit_fn(
-            tv0, ntv0, x, y, sw, xv, yv, sv, keys, wvalid
+        t_start = time.perf_counter()
+        if opt_state is None:
+            opt_state = opt_init_fn(tv0)
+        tv_out, ntv_out, opt_state_out, metrics = fit_fn(
+            tv0, ntv0, opt_state, x, y, sw, xv, yv, sv, keys, wvalid
         )
+        jax.block_until_ready(tv_out)
+        t_run = time.perf_counter() - t_start
 
         # -- install merged state back into the live model
         tv_out = [np.asarray(t) for t in tv_out]
@@ -203,7 +218,12 @@ class CompiledTrainer:
                 if "val_loss" in history:
                     line += f" - val_loss: {history['val_loss'][e]:.4f}"
                 print(line)
-        return FitResult(self.adapter.get_weights(), history)
+        return FitResult(
+            self.adapter.get_weights(), history,
+            opt_state=opt_state_out if keep_opt_state else None,
+            timings={"run_seconds": t_run,
+                     "samples_per_sec": sum(n_trains) * E / max(t_run, 1e-9)},
+        )
 
     # ------------------------------------------------------------------
     def _build(self, L: int, S: int, B: int, E: int, Sv: int, has_val: bool,
@@ -290,11 +310,16 @@ class CompiledTrainer:
             _, stats = jax.lax.scan(step, None, (xb, yb, svb))
             return jax.tree_util.tree_map(jnp.sum, stats)
 
-        def fit_impl(tv0, ntv0, x, y, sw, xv, yv, sv, keys, wvalid):
+        tile = lambda t: jnp.broadcast_to(t[None], (L,) + t.shape).astype(t.dtype)
+
+        def opt_init_impl(tv0):
+            # Per-worker optimizer state stack, identical at init.
+            return jax.vmap(optimizer.init)(jax.tree_util.tree_map(tile, tv0))
+
+        def fit_impl(tv0, ntv0, opt_stack, x, y, sw, xv, yv, sv, keys, wvalid):
             # Local shapes inside the shard: x [L, N, ...], keys [L, 2],
-            # wvalid [L]; tv0/ntv0 replicated.
+            # wvalid [L]; tv0/ntv0 replicated; opt_stack [L, ...] per shard.
             denom = jnp.maximum(jax.lax.psum(jnp.sum(wvalid), DATA_AXIS), 1.0)
-            tile = lambda t: jnp.broadcast_to(t[None], (L,) + t.shape).astype(t.dtype)
             tv_stack = jax.tree_util.tree_map(tile, tv0)
             # Non-mergeable integer ntv entries are seed-generator state:
             # offset each replica by its global worker id so dropout masks are
@@ -307,7 +332,6 @@ class CompiledTrainer:
                 if not is_m and jnp.issubdtype(jnp.asarray(t).dtype, jnp.integer):
                     tiled = tiled + widx.reshape((L,) + (1,) * jnp.asarray(t).ndim).astype(tiled.dtype)
                 ntv_stack.append(tiled)
-            opt_stack = jax.vmap(optimizer.init)(tv_stack)
             base_tv, base_ntv = tv0, list(ntv0)
 
             def epoch_body(carry, e):
@@ -406,7 +430,7 @@ class CompiledTrainer:
                 base_ntv = [v[0] for v in merged_full]
 
             ntv_mergeable_out = [v for v, m in zip(base_ntv, mergeable) if m]
-            return base_tv, ntv_mergeable_out, metrics
+            return base_tv, ntv_mergeable_out, opt_stack, metrics
 
         mesh = self.mesh
         pspec_rep = P()
@@ -417,8 +441,13 @@ class CompiledTrainer:
             in_specs=(
                 pspec_rep, pspec_rep, pspec_data, pspec_data, pspec_data,
                 pspec_data, pspec_data, pspec_data, pspec_data, pspec_data,
+                pspec_data,
             ),
-            out_specs=(pspec_rep, pspec_rep, pspec_rep),
+            out_specs=(pspec_rep, pspec_rep, pspec_data, pspec_rep),
             check_vma=False,
         )
-        return jax.jit(shard_fit)
+        shard_opt_init = jax.shard_map(
+            opt_init_impl, mesh=mesh, in_specs=(pspec_rep,),
+            out_specs=pspec_data, check_vma=False,
+        )
+        return jax.jit(shard_fit), jax.jit(shard_opt_init)

@@ -107,8 +107,12 @@ class SparkModel:
         )
         self.master_metrics = master_metrics
         self.training_histories: List[Dict[str, Any]] = []
+        self.timings: List[Dict[str, float]] = []
         self._server = None
         self.client: Optional[BaseParameterClient] = None
+        self._jax_trainer: Optional[CompiledTrainer] = None
+        self._jax_trainer_model = None
+        self._checkpoint = (None, 1, False)
 
     # -- properties ------------------------------------------------------
     @property
@@ -133,17 +137,33 @@ class SparkModel:
 
     # -- training --------------------------------------------------------
     def fit(self, rdd: RDD, epochs: int = 10, batch_size: Optional[int] = None,
-            verbose: int = 0, validation_split: float = 0.1, **kwargs) -> None:
+            verbose: int = 0, validation_split: float = 0.1,
+            checkpoint_dir: Optional[str] = None,
+            checkpoint_frequency: int = 1, resume: bool = False,
+            profile_dir: Optional[str] = None, **kwargs) -> None:
         """Train on an RDD of ``(x, y)`` sample pairs.
 
         Mirrors reference ``SparkModel.fit`` (``spark_model.py:~100``):
         repartitions to ``num_workers`` and dispatches per mode.
+
+        TPU-build extensions (beyond the reference — SURVEY.md §5):
+        ``checkpoint_dir`` enables mid-training checkpointing every
+        ``checkpoint_frequency`` epochs with optimizer state; ``resume=True``
+        continues from the latest checkpoint; ``profile_dir`` captures a
+        ``jax.profiler`` trace of the training run.
         """
         batch_size = self.batch_size if batch_size is None else batch_size
         num_workers = self._resolve_num_workers()
         if rdd.getNumPartitions() != num_workers:
             rdd = rdd.repartition(num_workers)
-        self._fit(rdd, epochs, batch_size, verbose, validation_split)
+        self._checkpoint = (checkpoint_dir, checkpoint_frequency, resume)
+        if profile_dir is not None:
+            import jax
+
+            with jax.profiler.trace(profile_dir):
+                self._fit(rdd, epochs, batch_size, verbose, validation_split)
+        else:
+            self._fit(rdd, epochs, batch_size, verbose, validation_split)
 
     def _resolve_num_workers(self) -> int:
         if self.num_workers is not None:
@@ -177,32 +197,79 @@ class SparkModel:
         else:
             self._fit_host_async(rdd, epochs, batch_size, verbose, validation_split)
 
+    def _get_trainer(self) -> CompiledTrainer:
+        """Build (or reuse) the compiled trainer — reuse keeps XLA executables
+        cached across ``fit`` calls with the same geometry."""
+        if (
+            self._jax_trainer is None
+            or self._jax_trainer_model is not self._master_network
+        ):
+            from .models.adapters import KerasModelAdapter
+
+            mesh = self.mesh if self.mesh is not None else build_mesh()
+            adapter = KerasModelAdapter(
+                self._master_network,
+                loss=self.master_loss,
+                optimizer=self.master_optimizer,
+                metrics=self.master_metrics,
+                custom_objects=self.custom_objects,
+            )
+            self._jax_trainer = CompiledTrainer(
+                adapter, mesh, mode=self.mode, frequency=self.frequency,
+                merge=self.merge,
+            )
+            self._jax_trainer_model = self._master_network
+        return self._jax_trainer
+
     # -- fast path: one XLA program over the mesh ------------------------
     def _fit_jax(self, rdd, epochs, batch_size, verbose, validation_split):
-        from .models.adapters import KerasModelAdapter
-
         blocks = self._partition_blocks(rdd, batch_size)
         if not blocks:
             raise ValueError(
                 "All partitions were skipped (each needs > batch_size samples)"
             )
-        mesh = self.mesh if self.mesh is not None else build_mesh()
-        adapter = KerasModelAdapter(
-            self._master_network,
-            loss=self.master_loss,
-            optimizer=self.master_optimizer,
-            metrics=self.master_metrics,
-            custom_objects=self.custom_objects,
-        )
-        trainer = CompiledTrainer(
-            adapter, mesh, mode=self.mode, frequency=self.frequency,
-            merge=self.merge,
-        )
-        result = trainer.fit(
-            blocks, epochs=epochs, batch_size=batch_size,
-            validation_split=validation_split, verbose=verbose,
-        )
-        self.training_histories.append(result.history)
+        trainer = self._get_trainer()
+        checkpoint_dir, checkpoint_frequency, resume = self._checkpoint
+
+        if checkpoint_dir is None:
+            result = trainer.fit(
+                blocks, epochs=epochs, batch_size=batch_size,
+                validation_split=validation_split, verbose=verbose,
+            )
+            self.training_histories.append(result.history)
+            self.timings.append(result.timings)
+            return
+
+        # Checkpointed path: epoch-chunked fits carrying optimizer state.
+        # NOTE: in synchronous mode this merges per chunk instead of once per
+        # fit (the compiled program spans one chunk).
+        from .utils.checkpoint import has_checkpoint, load_checkpoint, save_checkpoint
+
+        start_epoch, opt_state = 0, None
+        if resume and has_checkpoint(checkpoint_dir):
+            weights, meta, opt_state = load_checkpoint(checkpoint_dir)
+            self._master_network.set_weights(weights)
+            start_epoch = int(meta.get("epoch", 0))
+        merged: Dict[str, List[float]] = {}
+        epoch = start_epoch
+        while epoch < epochs:
+            chunk = min(checkpoint_frequency, epochs - epoch)
+            result = trainer.fit(
+                blocks, epochs=chunk, batch_size=batch_size,
+                validation_split=validation_split, verbose=verbose,
+                seed=epoch, opt_state=opt_state, keep_opt_state=True,
+            )
+            opt_state = result.opt_state
+            for k, v in result.history.items():
+                merged.setdefault(k, []).extend(v)
+            epoch += chunk
+            save_checkpoint(
+                checkpoint_dir, result.weights,
+                {"epoch": epoch, "epochs": epochs, "mode": self.mode},
+                opt_state,
+            )
+            self.timings.append(result.timings)
+        self.training_histories.append(merged)
 
     # -- host path: reference-shaped synchronous -------------------------
     def _fit_host_sync(self, rdd, epochs, batch_size, verbose, validation_split):

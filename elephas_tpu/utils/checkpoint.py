@@ -1,0 +1,63 @@
+"""Mid-training checkpoint / resume.
+
+The reference has only whole-model save (``SparkModel.save``; SURVEY.md §5.4
+"no mid-training checkpointing, no optimizer-state save, no resume"). The TPU
+build exceeds it: a checkpoint captures model weights, the engine's per-worker
+optimizer-state stack, and progress metadata, so a killed job resumes with
+optimizer momentum intact.
+
+Format: a directory with ``weights.npz`` (ordered weight list),
+``opt_state.npz`` + pickled treedef (the optimizer pytree is flattened to
+leaves; structure travels separately), and ``meta.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .serialization import load_weights_npz, save_weights_npz
+
+
+def save_checkpoint(directory: str, weights: List[np.ndarray],
+                    meta: Dict[str, Any], opt_state: Any = None) -> None:
+    os.makedirs(directory, exist_ok=True)
+    save_weights_npz(os.path.join(directory, "weights.npz"), weights)
+    if opt_state is not None:
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(opt_state)
+        np.savez(
+            os.path.join(directory, "opt_state.npz"),
+            **{f"l{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)},
+        )
+        with open(os.path.join(directory, "opt_treedef.pkl"), "wb") as f:
+            pickle.dump(treedef, f)
+    with open(os.path.join(directory, "meta.json"), "w") as f:
+        json.dump(meta, f)
+
+
+def load_checkpoint(directory: str) -> Tuple[List[np.ndarray], Dict[str, Any], Any]:
+    """Returns ``(weights, meta, opt_state_or_None)``."""
+    weights = load_weights_npz(os.path.join(directory, "weights.npz"))
+    with open(os.path.join(directory, "meta.json")) as f:
+        meta = json.load(f)
+    opt_state = None
+    opt_path = os.path.join(directory, "opt_state.npz")
+    if os.path.exists(opt_path):
+        import jax
+
+        with np.load(opt_path) as data:
+            leaves = [data[f"l{i}"] for i in range(len(data.files))]
+        with open(os.path.join(directory, "opt_treedef.pkl"), "rb") as f:
+            treedef = pickle.load(f)
+        opt_state = jax.tree_util.tree_unflatten(treedef, leaves)
+    return weights, meta, opt_state
+
+
+def has_checkpoint(directory: str) -> bool:
+    return os.path.exists(os.path.join(directory, "meta.json"))
