@@ -175,6 +175,26 @@ class CompiledTrainer:
         wvalid = np.array([1.0] * W + [0.0] * (Wp - W), np.float32)
         keys = jax.random.split(jax.random.PRNGKey(seed), Wp)
 
+        # Device staging cache: same block arrays + geometry → reuse the
+        # already-sharded device buffers instead of re-transferring host→HBM
+        # every fit (transfers can dominate when the device sits behind a
+        # relay/PCIe; data is immutable once staged).
+        from jax.sharding import NamedSharding
+
+        stage_key = (
+            tuple((id(bx), id(by)) for bx, by in blocks),
+            validation_split, N, Nv, Wp,
+        )
+        staged = getattr(self, "_staged", None)
+        if staged is not None and staged[0] == stage_key:
+            x, y, sw, xv, yv, sv, wvalid = staged[1]
+        else:
+            shard = NamedSharding(self.mesh, P(DATA_AXIS))
+            x, y, sw, xv, yv, sv, wvalid = (
+                jax.device_put(a, shard) for a in (x, y, sw, xv, yv, sv, wvalid)
+            )
+            self._staged = (stage_key, (x, y, sw, xv, yv, sv, wvalid))
+
         tv0, ntv0 = self.adapter.state_values()
         mergeable = [slot is not None for slot in self.adapter._ntv_slots]
 

@@ -1,0 +1,205 @@
+"""ctypes bindings for the native (C++) parameter server.
+
+The reference's parameter server is pure Python behind the GIL; this is the
+TPU build's native runtime equivalent (``native/ps_server.cpp``): contiguous
+float32 weight buffers, a binary wire protocol (no pickle), one C++ thread per
+connection, mutex vs lock-free (hogwild) delta application.
+
+Selected with ``SparkModel(parameter_server_mode='native')``. The shared
+library is compiled on first use with the system ``g++`` (pybind11 is not in
+this environment — plain ``ctypes`` over an ``extern "C"`` API instead) and
+cached under ``native/build/``.
+
+Weights are handled as float32; non-float32 arrays are cast on the way in and
+restored to their original dtype on the way out.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import socket
+import struct
+import subprocess
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+from .client import BaseParameterClient
+
+_NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "native",
+)
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libeps.so")
+_build_lock = threading.Lock()
+_lib = None
+
+
+def _load_library() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR], check=True, capture_output=True
+            )
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.eps_create.restype = ctypes.c_void_p
+        lib.eps_create.argtypes = [ctypes.c_int]
+        lib.eps_start.restype = ctypes.c_int
+        lib.eps_start.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.eps_set_weights.argtypes = [
+            ctypes.c_void_p, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+        ]
+        lib.eps_num_arrays.restype = ctypes.c_int
+        lib.eps_num_arrays.argtypes = [ctypes.c_void_p]
+        lib.eps_array_size.restype = ctypes.c_int64
+        lib.eps_array_size.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.eps_get_array.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_float)
+        ]
+        lib.eps_stop.argtypes = [ctypes.c_void_p]
+        lib.eps_destroy.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    try:
+        _load_library()
+        return True
+    except Exception:
+        return False
+
+
+class NativeServer:
+    """Lifecycle wrapper over the C++ server; API-compatible with
+    :class:`~elephas_tpu.parameter.server.BaseParameterServer`."""
+
+    def __init__(self, weights: List[np.ndarray], mode: str = "asynchronous",
+                 port: int = 4000, **_kwargs):
+        self._lib = _load_library()
+        self._handle = self._lib.eps_create(1 if mode == "hogwild" else 0)
+        self.mode = mode
+        self.port = int(port)
+        self._shapes = [np.asarray(w).shape for w in weights]
+        self._dtypes = [np.asarray(w).dtype for w in weights]
+        self._set_weights(weights)
+        self._running = False
+
+    def _set_weights(self, weights: List[np.ndarray]) -> None:
+        flat = [np.ascontiguousarray(np.asarray(w), dtype=np.float32).ravel()
+                for w in weights]
+        n = len(flat)
+        sizes = (ctypes.c_int64 * n)(*[a.size for a in flat])
+        ptrs = (ctypes.POINTER(ctypes.c_float) * n)(
+            *[a.ctypes.data_as(ctypes.POINTER(ctypes.c_float)) for a in flat]
+        )
+        self._lib.eps_set_weights(self._handle, n, sizes, ptrs)
+
+    def start(self) -> None:
+        bound = self._lib.eps_start(self._handle, self.port)
+        if bound < 0:
+            raise OSError(f"native parameter server failed to bind port {self.port}")
+        self.port = bound
+        self._running = True
+
+    def get_weights(self) -> List[np.ndarray]:
+        n = self._lib.eps_num_arrays(self._handle)
+        out = []
+        for i in range(n):
+            size = self._lib.eps_array_size(self._handle, i)
+            buf = np.empty(size, dtype=np.float32)
+            self._lib.eps_get_array(
+                self._handle, i, buf.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+            )
+            out.append(buf.reshape(self._shapes[i]).astype(self._dtypes[i]))
+        return out
+
+    def stop(self) -> None:
+        if self._handle is not None and self._running:
+            self._lib.eps_stop(self._handle)
+            self._running = False
+
+    def __del__(self):
+        try:
+            self.stop()
+            if self._handle is not None:
+                self._lib.eps_destroy(self._handle)
+                self._handle = None
+        except Exception:
+            pass
+
+
+class NativeClient(BaseParameterClient):
+    """Binary-protocol client for :class:`NativeServer`.
+
+    Python-side framing is just ``struct`` + raw ``ndarray`` bytes — no
+    pickle. Shapes/dtypes are fixed at construction (the weight schema of one
+    model), as the wire carries flat float32 buffers only.
+    """
+
+    def __init__(self, shapes, dtypes, port: int, host: str = "127.0.0.1"):
+        self.shapes = list(shapes)
+        self.dtypes = list(dtypes)
+        self.host = host
+        self.port = int(port)
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _ensure(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.create_connection((self.host, self.port),
+                                                  timeout=60)
+            self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return self._sock
+
+    def _read_exact(self, sock: socket.socket, n: int) -> bytes:
+        chunks = []
+        while n > 0:
+            chunk = sock.recv(min(n, 1 << 20))
+            if not chunk:
+                raise ConnectionError("native PS closed connection")
+            chunks.append(chunk)
+            n -= len(chunk)
+        return b"".join(chunks)
+
+    def get_parameters(self) -> List[np.ndarray]:
+        with self._lock:
+            sock = self._ensure()
+            sock.sendall(b"G")
+            (n_arrays,) = struct.unpack("<I", self._read_exact(sock, 4))
+            out = []
+            for i in range(n_arrays):
+                (nelem,) = struct.unpack("<Q", self._read_exact(sock, 8))
+                buf = np.frombuffer(
+                    self._read_exact(sock, int(nelem) * 4), dtype="<f4"
+                )
+                out.append(buf.reshape(self.shapes[i]).astype(self.dtypes[i]))
+            return out
+
+    def update_parameters(self, delta: List[np.ndarray]) -> None:
+        with self._lock:
+            sock = self._ensure()
+            parts = [b"U", struct.pack("<I", len(delta))]
+            for d in delta:
+                flat = np.ascontiguousarray(d, dtype="<f4").ravel()
+                parts.append(struct.pack("<Q", flat.size))
+                parts.append(flat.tobytes())
+            sock.sendall(b"".join(parts))
+            ack = self._read_exact(sock, 1)
+            if ack != b"A":
+                raise ConnectionError(f"native PS bad ack: {ack!r}")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None

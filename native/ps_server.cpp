@@ -1,0 +1,253 @@
+// Native parameter-server runtime.
+//
+// The reference's parameter server is pure Python (Flask / socket + pickle,
+// elephas/parameter/server.py) — its throughput ceiling is the GIL plus
+// pickle. This is the TPU build's native equivalent: a C++ TCP server holding
+// the master weights as contiguous float32 buffers, applying pushed deltas
+// with lock-free (hogwild) or mutex-serialized (asynchronous) semantics, one
+// thread per connection, zero Python in the data path.
+//
+// Wire protocol (binary, little-endian):
+//   'G'                                   -> reply: u32 n_arrays, then per
+//                                            array u64 nelem + nelem*f32
+//   'U' u32 n_arrays { u64 nelem, f32[] } -> weights[i] -= delta[i]; reply 'A'
+//
+// Exposed through a minimal C API consumed via ctypes
+// (elephas_tpu/parameter/native.py). Build: native/Makefile (g++ -O3
+// -shared -fPIC -pthread).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct WeightStore {
+  std::vector<std::vector<float>> arrays;
+  std::mutex mu;
+  bool hogwild = false;
+
+  void apply_delta(const std::vector<std::vector<float>>& delta) {
+    if (hogwild) {
+      subtract(delta);  // racy by design: HOGWILD! semantics
+    } else {
+      std::lock_guard<std::mutex> lock(mu);
+      subtract(delta);
+    }
+  }
+
+  void subtract(const std::vector<std::vector<float>>& delta) {
+    for (size_t i = 0; i < arrays.size() && i < delta.size(); ++i) {
+      float* w = arrays[i].data();
+      const float* d = delta[i].data();
+      const size_t n = std::min(arrays[i].size(), delta[i].size());
+      for (size_t j = 0; j < n; ++j) w[j] -= d[j];
+    }
+  }
+
+  // Snapshot under the lock (hogwild reads race by design, matching the
+  // reference's lock-free GET).
+  std::vector<std::vector<float>> snapshot() {
+    if (hogwild) return arrays;
+    std::lock_guard<std::mutex> lock(mu);
+    return arrays;
+  }
+};
+
+struct Server {
+  WeightStore store;
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> running{false};
+  std::thread accept_thread;
+  std::vector<std::thread> conn_threads;
+  std::mutex conn_mu;
+};
+
+// recv with a 200ms socket timeout on connection fds: EAGAIN retries while
+// the server is running, so eps_stop() can always join connection threads
+// instead of hanging on a blocked recv.
+bool read_exact(int fd, void* buf, size_t n, const std::atomic<bool>* running) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      if (running != nullptr && !running->load()) return false;
+      continue;
+    }
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool read_weight_lists(int fd, std::vector<std::vector<float>>* out,
+                       const std::atomic<bool>* running) {
+  uint32_t n_arrays = 0;
+  if (!read_exact(fd, &n_arrays, sizeof(n_arrays), running)) return false;
+  if (n_arrays > 100000) return false;  // sanity bound
+  out->resize(n_arrays);
+  for (uint32_t i = 0; i < n_arrays; ++i) {
+    uint64_t nelem = 0;
+    if (!read_exact(fd, &nelem, sizeof(nelem), running)) return false;
+    if (nelem > (1ull << 34)) return false;  // 16B floats * 4 = 64GB cap
+    (*out)[i].resize(nelem);
+    if (!read_exact(fd, (*out)[i].data(), nelem * sizeof(float), running))
+      return false;
+  }
+  return true;
+}
+
+bool write_weight_lists(int fd, const std::vector<std::vector<float>>& arrays) {
+  uint32_t n_arrays = static_cast<uint32_t>(arrays.size());
+  if (!write_exact(fd, &n_arrays, sizeof(n_arrays))) return false;
+  for (const auto& a : arrays) {
+    uint64_t nelem = a.size();
+    if (!write_exact(fd, &nelem, sizeof(nelem))) return false;
+    if (!write_exact(fd, a.data(), nelem * sizeof(float))) return false;
+  }
+  return true;
+}
+
+void serve_connection(Server* s, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  timeval tv{0, 200000};  // 200ms — lets threads notice eps_stop()
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  while (s->running.load()) {
+    char op = 0;
+    if (!read_exact(fd, &op, 1, &s->running)) break;
+    if (op == 'G') {
+      auto snap = s->store.snapshot();
+      if (!write_weight_lists(fd, snap)) break;
+    } else if (op == 'U') {
+      std::vector<std::vector<float>> delta;
+      if (!read_weight_lists(fd, &delta, &s->running)) break;
+      s->store.apply_delta(delta);
+      char ack = 'A';
+      if (!write_exact(fd, &ack, 1)) break;
+    } else {
+      break;
+    }
+  }
+  ::close(fd);
+}
+
+void accept_loop(Server* s) {
+  while (s->running.load()) {
+    sockaddr_in addr{};
+    socklen_t len = sizeof(addr);
+    int fd = ::accept(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &len);
+    if (fd < 0) {
+      if (!s->running.load()) break;
+      continue;
+    }
+    std::lock_guard<std::mutex> lock(s->conn_mu);
+    s->conn_threads.emplace_back(serve_connection, s, fd);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* eps_create(int hogwild) {
+  auto* s = new Server();
+  s->store.hogwild = hogwild != 0;
+  return s;
+}
+
+// Returns the bound port (pass port=0 for an OS-assigned one), or -1.
+int eps_start(void* handle, int port) {
+  auto* s = static_cast<Server*>(handle);
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) return -1;
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0)
+    return -1;
+  if (::listen(s->listen_fd, 64) < 0) return -1;
+  socklen_t len = sizeof(addr);
+  ::getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  s->port = ntohs(addr.sin_port);
+  s->running.store(true);
+  s->accept_thread = std::thread(accept_loop, s);
+  return s->port;
+}
+
+void eps_set_weights(void* handle, int n_arrays, const int64_t* sizes,
+                     const float* const* data) {
+  auto* s = static_cast<Server*>(handle);
+  std::lock_guard<std::mutex> lock(s->store.mu);
+  s->store.arrays.resize(static_cast<size_t>(n_arrays));
+  for (int i = 0; i < n_arrays; ++i) {
+    s->store.arrays[i].assign(data[i], data[i] + sizes[i]);
+  }
+}
+
+int eps_num_arrays(void* handle) {
+  auto* s = static_cast<Server*>(handle);
+  std::lock_guard<std::mutex> lock(s->store.mu);
+  return static_cast<int>(s->store.arrays.size());
+}
+
+int64_t eps_array_size(void* handle, int idx) {
+  auto* s = static_cast<Server*>(handle);
+  std::lock_guard<std::mutex> lock(s->store.mu);
+  return static_cast<int64_t>(s->store.arrays[static_cast<size_t>(idx)].size());
+}
+
+void eps_get_array(void* handle, int idx, float* out) {
+  auto* s = static_cast<Server*>(handle);
+  std::lock_guard<std::mutex> lock(s->store.mu);
+  const auto& a = s->store.arrays[static_cast<size_t>(idx)];
+  std::memcpy(out, a.data(), a.size() * sizeof(float));
+}
+
+void eps_stop(void* handle) {
+  auto* s = static_cast<Server*>(handle);
+  s->running.store(false);
+  if (s->listen_fd >= 0) {
+    ::shutdown(s->listen_fd, SHUT_RDWR);
+    ::close(s->listen_fd);
+    s->listen_fd = -1;
+  }
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  std::lock_guard<std::mutex> lock(s->conn_mu);
+  for (auto& t : s->conn_threads)
+    if (t.joinable()) t.join();
+  s->conn_threads.clear();
+}
+
+void eps_destroy(void* handle) {
+  auto* s = static_cast<Server*>(handle);
+  delete s;
+}
+
+}  // extern "C"
