@@ -50,6 +50,12 @@ def _categorical_crossentropy(from_logits: bool):
     def fn(y_true, y_pred):
         y_true = y_true.astype(y_pred.dtype)
         if from_logits:
+            if y_pred.ndim == 2:
+                # Hot path: fused Pallas kernel on TPU (one VMEM pass +
+                # on-chip softmax recompute in the VJP), jnp elsewhere.
+                from ..ops.pallas_ops import categorical_crossentropy_from_logits
+
+                return categorical_crossentropy_from_logits(y_pred, y_true)
             logp = jax.nn.log_softmax(y_pred, axis=-1)
         else:
             logp = jnp.log(jnp.clip(y_pred, _EPS, 1.0))

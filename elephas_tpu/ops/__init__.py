@@ -1,1 +1,18 @@
-"""Custom TPU ops (Pallas kernels) — populated as hot ops are identified."""
+"""Custom TPU ops (Pallas kernels).
+
+``pallas_ops`` holds the fused classification-loss kernel (used automatically
+on TPU via ``models.losses``); jnp reference implementations double as CPU
+fallbacks and test oracles.
+"""
+
+from .pallas_ops import (
+    categorical_crossentropy_from_logits,
+    fused_xent_from_logits,
+    xent_from_logits_reference,
+)
+
+__all__ = [
+    "categorical_crossentropy_from_logits",
+    "fused_xent_from_logits",
+    "xent_from_logits_reference",
+]

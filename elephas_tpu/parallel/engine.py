@@ -470,4 +470,7 @@ class CompiledTrainer:
             opt_init_impl, mesh=mesh, in_specs=(pspec_rep,),
             out_specs=pspec_data, check_vma=False,
         )
-        return jax.jit(shard_fit), jax.jit(shard_opt_init)
+        # Donate the optimizer-state stack: it is consumed and returned every
+        # call, so aliasing its buffers halves its HBM footprint (arg 2 =
+        # opt_stack in fit_impl's signature).
+        return jax.jit(shard_fit, donate_argnums=(2,)), jax.jit(shard_opt_init)
