@@ -119,6 +119,7 @@ class HttpServer(BaseParameterServer):
                     self.send_error(404)
 
         self._httpd = http.server.ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
+        self.port = self._httpd.server_address[1]  # resolves port=0 → OS port
         self._thread = threading.Thread(target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
         self._running = True
@@ -155,6 +156,7 @@ class SocketServer(BaseParameterServer):
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind(("0.0.0.0", self.port))
+        self.port = self._sock.getsockname()[1]  # resolves port=0 → OS port
         self._sock.listen(16)
         self._sock.settimeout(0.2)
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)

@@ -1,0 +1,66 @@
+"""``ElephasEstimator`` basics on a DataFrame (reference ``examples/ml_mlp.py``)."""
+
+import os
+import sys
+
+os.environ.setdefault("KERAS_BACKEND", "jax")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import keras
+import numpy as np
+
+from elephas_tpu import ElephasEstimator
+from elephas_tpu.data import Row, SparkSession
+from elephas_tpu.mllib import Vectors
+
+from _datasets import load_mnist  # noqa: E402
+
+
+def main():
+    import jax
+
+    n_workers = jax.local_device_count()
+    spark = SparkSession.builder.master(f"local[{n_workers}]").appName(
+        "ml_mlp"
+    ).getOrCreate()
+    (x_train, y_train), (x_test, y_test) = load_mnist(n_train=8192, n_test=1024)
+
+    df = spark.createDataFrame(
+        [Row(features=Vectors.dense(x.astype("float64")),
+             label=float(y.argmax())) for x, y in zip(x_train, y_train)]
+    )
+
+    model = keras.Sequential(
+        [keras.layers.Dense(128, activation="relu"),
+         keras.layers.Dense(10, activation="softmax")]
+    )
+    model.build((None, 784))
+    model.compile(optimizer="adam", loss="categorical_crossentropy",
+                  metrics=["accuracy"])
+
+    estimator = ElephasEstimator()
+    estimator.set_keras_model(model)
+    estimator.set_categorical(True)
+    estimator.set_nb_classes(10)
+    estimator.set_num_workers(n_workers)
+    estimator.set_epochs(3)
+    estimator.set_batch_size(64)
+    estimator.set_validation_split(0.1)
+    estimator.set_mode("synchronous")
+    estimator.set_parameter_server_mode("jax")
+
+    transformer = estimator.fit(df)
+
+    test_df = spark.createDataFrame(
+        [Row(features=Vectors.dense(x.astype("float64")),
+             label=float(y.argmax())) for x, y in zip(x_test, y_test)]
+    )
+    out = transformer.transform(test_df)
+    preds = np.array([r.prediction for r in out.collect()])
+    labels = np.array([r.label for r in out.collect()])
+    print(f"test accuracy: {float((preds == labels).mean()):.4f}")
+    spark.stop()
+
+
+if __name__ == "__main__":
+    main()
