@@ -36,6 +36,41 @@ def _tree_where(cond, new, old):
     )
 
 
+def _is_accuracy_name(name) -> bool:
+    return "accuracy" in str(name) or str(name) == "acc"
+
+
+def compile_metric_names(model) -> Tuple[List[str], List[str]]:
+    """``(metric_names, weighted_metric_names)`` from ``model.compile(...)``.
+
+    The single source of truth for compile-metric introspection (Keras 3 keeps
+    the raw specs on the private ``CompileMetrics`` container, unbuilt until
+    the first train step) — used both by :class:`KerasModelAdapter` metric
+    inference and by the ``SparkModel.evaluate`` fast-path gate, so the two
+    can never disagree about what the user compiled.
+    """
+    names: List[str] = []
+    weighted: List[str] = []
+
+    def scan(spec, out):
+        if spec is None:
+            return
+        if isinstance(spec, (list, tuple)):
+            for s in spec:
+                scan(s, out)
+            return
+        if isinstance(spec, dict):
+            for s in spec.values():
+                scan(s, out)
+            return
+        out.append(spec if isinstance(spec, str) else str(getattr(spec, "name", spec)))
+
+    cm = getattr(model, "_compile_metrics", None)
+    scan(getattr(cm, "_user_metrics", None), names)
+    scan(getattr(cm, "_user_weighted_metrics", None), weighted)
+    return names, weighted
+
+
 class KerasModelAdapter:
     """Functional view over a built & compiled Keras-3 model."""
 
@@ -49,11 +84,9 @@ class KerasModelAdapter:
             )
         self.model = model
         self.custom_objects = custom_objects
+        # Loss may be absent (inference-only use: predict needs none); the
+        # train/eval step builders raise lazily when they actually need it.
         self.loss_spec = loss if loss is not None else getattr(model, "loss", None)
-        if self.loss_spec is None:
-            raise ValueError(
-                "No loss available: compile the model or pass loss= explicitly."
-            )
         self.optimizer_spec = (
             optimizer if optimizer is not None else getattr(model, "optimizer", None)
         ) or "sgd"
@@ -70,29 +103,17 @@ class KerasModelAdapter:
 
     # -- introspection ---------------------------------------------------
     def _infer_metrics(self) -> List[str]:
-        names: List[str] = []
-
-        def scan(spec):
-            if spec is None:
-                return
-            if isinstance(spec, (list, tuple)):
-                for s in spec:
-                    scan(s)
-                return
-            n = spec if isinstance(spec, str) else getattr(spec, "name", "")
-            if "accuracy" in str(n) or str(n) in ("acc",):
-                names.append("accuracy")
-
-        # Keras 3 keeps the raw compile(metrics=...) specs on the
-        # CompileMetrics container (unbuilt until first train step).
-        cm = getattr(self.model, "_compile_metrics", None)
-        scan(getattr(cm, "_user_metrics", None))
-        try:
-            for m in self.model.metrics:
-                scan(getattr(m, "name", ""))
-        except Exception:
-            pass
-        return sorted(set(names))
+        names, weighted = compile_metric_names(self.model)
+        found = [n for n in names + weighted if _is_accuracy_name(n)]
+        if not found:
+            try:
+                found = [
+                    m for m in (getattr(m, "name", "") for m in self.model.metrics)
+                    if _is_accuracy_name(m)
+                ]
+            except Exception:
+                pass
+        return ["accuracy"] if found else []
 
     @property
     def wants_accuracy(self) -> bool:
@@ -155,17 +176,30 @@ class KerasModelAdapter:
             var.assign(np.asarray(value))
 
     # -- compiled-step builders ------------------------------------------
+    def _require_loss(self):
+        if self.loss_spec is None:
+            raise ValueError(
+                "No loss available: compile the model or pass loss= explicitly."
+            )
+        return self.loss_spec
+
     def make_optimizer(self):
         return to_optax(self.optimizer_spec)
 
-    def build_train_step(self, optimizer) -> Callable:
+    def build_train_step(self, optimizer, remat: bool = False) -> Callable:
         """``(tv, ntv, opt_state, x, y, sw) → (tv, ntv, opt_state, stats)``.
 
         ``stats`` is ``(loss_weighted_sum, acc_weighted_sum, weight_sum)`` so
         callers can aggregate exact weighted means across steps/workers.
+
+        ``remat=True`` wraps the loss computation in ``jax.checkpoint`` so the
+        backward pass recomputes activations instead of storing them — the
+        standard HBM-for-FLOPs trade for deep models (ResNet-class) whose
+        activation footprint would not otherwise fit alongside per-worker
+        replica stacks.
         """
         model = self.model
-        per_sample_loss = resolve_per_sample_loss(self.loss_spec)
+        per_sample_loss = resolve_per_sample_loss(self._require_loss())
         acc_fn = resolve_accuracy(self.loss_spec) if self.wants_accuracy else None
 
         def train_step(tv, ntv, opt_state, x, y, sw):
@@ -176,6 +210,8 @@ class KerasModelAdapter:
                 loss = jnp.sum(per * sw) / jnp.maximum(wsum, 1e-9)
                 return loss, (ntv2, y_pred)
 
+            if remat:
+                _loss = jax.checkpoint(_loss)
             (loss, (ntv2, y_pred)), grads = jax.value_and_grad(
                 _loss, has_aux=True
             )(tv)
@@ -199,7 +235,7 @@ class KerasModelAdapter:
     def build_eval_step(self) -> Callable:
         """``(tv, ntv, x, y, sw) → (loss_wsum, acc_wsum, wsum)``."""
         model = self.model
-        per_sample_loss = resolve_per_sample_loss(self.loss_spec)
+        per_sample_loss = resolve_per_sample_loss(self._require_loss())
         acc_fn = resolve_accuracy(self.loss_spec) if self.wants_accuracy else None
 
         def eval_step(tv, ntv, x, y, sw):

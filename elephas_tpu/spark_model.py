@@ -70,7 +70,7 @@ class SparkModel:
                  num_workers: Optional[int] = None,
                  custom_objects: Optional[dict] = None, batch_size: int = 32,
                  port: int = 4000, mesh=None, merge: str = "auto",
-                 comm: Optional[str] = None,
+                 comm: Optional[str] = None, remat: bool = False,
                  master_optimizer=None, master_loss=None, master_metrics=None,
                  *args, **kwargs):
         if mode not in ("synchronous", "asynchronous", "hogwild"):
@@ -89,6 +89,7 @@ class SparkModel:
         self.port = port
         self.merge = merge
         self.mesh = mesh
+        self.remat = remat
         # comm overrides: 'jax' = on-device engine, 'host' = reference-shaped
         # host path. Default: sync → jax; async → per parameter_server_mode.
         if comm is None:
@@ -133,6 +134,7 @@ class SparkModel:
             "port": self.port,
             "merge": self.merge,
             "comm": self.comm,
+            "remat": self.remat,
         }
 
     # -- training --------------------------------------------------------
@@ -227,7 +229,7 @@ class SparkModel:
             )
             self._jax_trainer = CompiledTrainer(
                 adapter, mesh, mode=self.mode, frequency=self.frequency,
-                merge=self.merge,
+                merge=self.merge, remat=self.remat,
             )
             self._jax_trainer_model = self._master_network
         return self._jax_trainer
@@ -386,11 +388,34 @@ class SparkModel:
             self.stop_server()
 
     # -- inference -------------------------------------------------------
-    def predict(self, data):
-        """Predict on a numpy array (driver-local, reference behavior) or an
-        RDD of feature rows (distributed, maintained-fork behavior)."""
+    def predict(self, data, batch_size: Optional[int] = None):
+        """Predict on a numpy array (reference: driver-local evaluation) or an
+        RDD of feature rows (maintained-fork distributed predict).
+
+        On the fast path (``comm='jax'``) both forms run mesh-sharded: ONE
+        compiled XLA program with rows sharded over the ``"data"`` axis —
+        the TPU-native analog of the fork's per-executor replica predict.
+        Host path keeps the reference's literal shape (Keras replica per
+        partition via ``mapPartitions``).
+        """
         model = self._master_network
+        batch_size = self.batch_size if batch_size is None else batch_size
         if isinstance(data, RDD):
+            if self.comm == "jax":
+                # The RDD facade is in-process: stage rows once, predict on
+                # the mesh, hand back an RDD with the partitioning preserved.
+                parts = data.partitions()
+                rows = [np.asarray(r) for part in parts for r in part]
+                if not rows:
+                    return RDD([[] for _ in parts], data.context)
+                preds = self._get_trainer().predict(
+                    np.stack(rows), batch_size=batch_size
+                )
+                out_parts, i = [], 0
+                for part in parts:
+                    out_parts.append(list(preds[i:i + len(part)]))
+                    i += len(part)
+                return RDD(out_parts, data.context)
             json_config = model.to_json()
             weights = data.context.broadcast(model.get_weights())
             custom_objects = self.custom_objects
@@ -405,13 +430,48 @@ class SparkModel:
                     json_config, custom_objects=custom_objects
                 )
                 replica.set_weights(weights.value)
-                preds = replica.predict(np.stack(rows), verbose=0)
+                preds = replica.predict(
+                    np.stack(rows), batch_size=batch_size, verbose=0
+                )
                 yield from preds
 
             return data.mapPartitions(predict_partition)
-        return model.predict(np.asarray(data), verbose=0)
+        if self.comm == "jax":
+            return self._get_trainer().predict(
+                np.asarray(data), batch_size=batch_size
+            )
+        return model.predict(np.asarray(data), batch_size=batch_size, verbose=0)
+
+    def _compiled_eval_representable(self) -> bool:
+        """True when the compiled eval path emits exactly the shape Keras
+        ``evaluate`` would: loss plus (only) an accuracy metric. Weighted
+        metrics, non-accuracy metrics (mae, auc, custom), or a gate/adapter
+        disagreement (``master_metrics`` overrides) all fail over to Keras so
+        no metric is ever silently dropped."""
+        from .models.adapters import _is_accuracy_name, compile_metric_names
+
+        names, weighted = compile_metric_names(self._master_network)
+        if weighted or not all(_is_accuracy_name(n) for n in names):
+            return False
+        wants = self._get_trainer().adapter.wants_accuracy
+        return wants == bool(names)
 
     def evaluate(self, x, y, **kwargs):
+        """Loss (and accuracy) on held-out data. Fast path: mesh-sharded
+        compiled evaluation; host path: driver-local Keras ``evaluate``
+        (reference behavior). Return format matches Keras: scalar loss, or
+        ``[loss, accuracy]`` when an accuracy metric is compiled in. Models
+        compiled with other metrics always evaluate through Keras so the
+        return shape never changes."""
+        if self.comm == "jax" and self._compiled_eval_representable():
+            trainer = self._get_trainer()
+            res = trainer.evaluate(
+                np.asarray(x), np.asarray(y),
+                batch_size=kwargs.get("batch_size", self.batch_size),
+            )
+            if "accuracy" in res:
+                return [res["loss"], res["accuracy"]]
+            return res["loss"]
         return self._master_network.evaluate(
             np.asarray(x), np.asarray(y), verbose=kwargs.get("verbose", 0)
         )
@@ -451,6 +511,7 @@ def load_spark_model(path: str, custom_objects: Optional[dict] = None) -> SparkM
         port=config.get("port", 4000),
         merge=config.get("merge", "auto"),
         comm=config.get("comm"),
+        remat=config.get("remat", False),
     )
 
 

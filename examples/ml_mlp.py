@@ -55,9 +55,9 @@ def main():
         [Row(features=Vectors.dense(x.astype("float64")),
              label=float(y.argmax())) for x, y in zip(x_test, y_test)]
     )
-    out = transformer.transform(test_df)
-    preds = np.array([r.prediction for r in out.collect()])
-    labels = np.array([r.label for r in out.collect()])
+    rows = transformer.transform(test_df).collect()
+    preds = np.array([r.prediction for r in rows])
+    labels = np.array([r.label for r in rows])
     print(f"test accuracy: {float((preds == labels).mean()):.4f}")
     spark.stop()
 

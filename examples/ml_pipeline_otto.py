@@ -76,9 +76,9 @@ def main():
         ]
     )
     fitted = pipeline.fit(df)
-    out = fitted.transform(df)
-    preds = np.array([r.prediction for r in out.collect()])
-    labels = np.array([r.label for r in out.collect()])
+    rows = fitted.transform(df).collect()
+    preds = np.array([r.prediction for r in rows])
+    labels = np.array([r.label for r in rows])
     print(f"Otto pipeline train accuracy: {float((preds == labels).mean()):.4f}")
     spark.stop()
 

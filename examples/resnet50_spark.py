@@ -41,7 +41,11 @@ def main():
 
     sc = SparkContext(master=f"local[{n_workers}]", appName="resnet50")
     rdd = to_simple_rdd(sc, x, y)
-    spark_model = SparkModel(model, mode="synchronous", num_workers=n_workers)
+    # remat: recompute activations in the backward pass — ResNet-class
+    # activation footprints don't otherwise fit next to replica stacks in HBM.
+    spark_model = SparkModel(
+        model, mode="synchronous", num_workers=n_workers, remat=True
+    )
     spark_model.fit(rdd, epochs=epochs, batch_size=16, verbose=1,
                     validation_split=0.0)
     h = spark_model.training_histories[-1]

@@ -108,6 +108,125 @@ def test_predict_array_and_rdd(rdd, toy_classification, spark_context):
     feature_rdd = spark_context.parallelize([row for row in x[:10]], 2)
     dist_preds = np.stack(spark_model.predict(feature_rdd).collect())
     assert np.allclose(dist_preds, preds, atol=1e-5)
+    # host path: the reference-shaped mapPartitions replica predict
+    host_model = SparkModel(
+        spark_model.master_network, mode="synchronous", num_workers=4,
+        comm="host",
+    )
+    host_preds = np.stack(host_model.predict(feature_rdd).collect())
+    assert np.allclose(host_preds, preds, atol=1e-5)
+
+
+def test_compiled_predict_matches_keras(toy_classification):
+    """Mesh-sharded compiled predict ≡ driver-local Keras predict."""
+    x, _ = toy_classification
+    model = make_classifier()
+    spark_model = SparkModel(model, mode="synchronous", num_workers=4)
+    ref = model.predict(x, verbose=0)
+    fast = spark_model.predict(x)  # comm='jax' → compiled sharded path
+    assert fast.shape == ref.shape
+    assert np.allclose(fast, ref, atol=1e-5)
+    # odd-sized inputs exercise padding/bucketing
+    assert np.allclose(spark_model.predict(x[:37]), ref[:37], atol=1e-5)
+
+
+def test_compiled_evaluate_matches_keras(toy_classification):
+    x, y = toy_classification
+    model = make_classifier()
+    spark_model = SparkModel(model, mode="synchronous", num_workers=4)
+    ref_loss, ref_acc = model.evaluate(x, y, verbose=0)
+    loss, acc = spark_model.evaluate(x, y)
+    assert abs(loss - ref_loss) < 1e-3
+    assert abs(acc - ref_acc) < 1e-6
+
+
+def test_evaluate_non_accuracy_metrics_fall_back(toy_classification):
+    """A model compiled with non-accuracy metrics must keep the Keras
+    return shape from evaluate (the compiled path only knows accuracy)."""
+    import keras
+
+    x, y = toy_classification
+    model = keras.Sequential(
+        [keras.layers.Dense(16, activation="relu"), keras.layers.Dense(3)]
+    )
+    model.build((None, 10))
+    model.compile(optimizer="adam", loss="mse", metrics=["mae"])
+    spark_model = SparkModel(model, mode="synchronous", num_workers=4)
+    ref = model.evaluate(x, y, verbose=0)
+    got = spark_model.evaluate(x, y)
+    assert isinstance(got, list) and len(got) == len(ref)
+    assert np.allclose(got, ref, atol=1e-5)
+
+
+def test_evaluate_weighted_metrics_fall_back(toy_classification):
+    """weighted_metrics live outside the compiled path's reach → Keras."""
+    import keras
+
+    x, y = toy_classification
+    model = keras.Sequential(
+        [keras.layers.Dense(16, activation="relu"), keras.layers.Dense(3)]
+    )
+    model.build((None, 10))
+    model.compile(optimizer="adam", loss="mse", weighted_metrics=["mae"])
+    spark_model = SparkModel(model, mode="synchronous", num_workers=4)
+    ref = model.evaluate(x, y, verbose=0)
+    got = spark_model.evaluate(x, y)
+    assert isinstance(got, list) and len(got) == len(ref)
+    assert np.allclose(got, ref, atol=1e-5)
+
+
+def test_evaluate_master_metrics_override_falls_back(toy_classification):
+    """master_metrics=['mae'] on an accuracy-compiled model → gate/adapter
+    disagree → must fail over to Keras, keeping the Keras return shape."""
+    x, y = toy_classification
+    model = make_classifier()
+    spark_model = SparkModel(
+        model, mode="synchronous", num_workers=4, master_metrics=["mae"]
+    )
+    ref = model.evaluate(x, y, verbose=0)
+    got = spark_model.evaluate(x, y)
+    assert isinstance(got, list) and len(got) == len(ref)
+    assert np.allclose(got, ref, atol=1e-5)
+
+
+def test_predict_uncompiled_model(toy_classification):
+    """predict needs no loss: an unfitted, uncompiled (built) model predicts
+    on the fast path just like driver-local Keras predict did."""
+    import keras
+
+    x, _ = toy_classification
+    model = keras.Sequential(
+        [keras.layers.Dense(16, activation="relu"),
+         keras.layers.Dense(3, activation="softmax")]
+    )
+    model.build((None, 10))
+    spark_model = SparkModel(model, mode="synchronous", num_workers=4)
+    preds = spark_model.predict(x[:10])
+    assert np.allclose(preds, model.predict(x[:10], verbose=0), atol=1e-5)
+    # ...but fitting without a loss still raises the clean error
+    with pytest.raises(ValueError, match="No loss available"):
+        spark_model._get_trainer().adapter.build_train_step(
+            spark_model._get_trainer().optimizer
+        )
+
+
+def test_remat_trains_equivalently(rdd, toy_classification):
+    """``remat=True`` (jax.checkpoint in the backward pass) must not change
+    the math — same seed/geometry trains to the same weights."""
+    x, y = toy_classification
+    import keras
+
+    results = []
+    for remat in (False, True):
+        keras.utils.set_random_seed(123)
+        model = make_classifier()
+        spark_model = SparkModel(
+            model, mode="synchronous", num_workers=4, remat=remat
+        )
+        spark_model.fit(rdd, epochs=2, batch_size=16, validation_split=0.0)
+        results.append(spark_model.master_network.get_weights())
+    for a, b in zip(*results):
+        assert np.allclose(a, b, atol=1e-5)
 
 
 def test_save_and_load(tmp_path, rdd, toy_classification):
