@@ -1,4 +1,12 @@
+from .distributed import global_mesh, initialize_cluster
 from .engine import CompiledTrainer, FitResult
 from .mesh import DATA_AXIS, build_mesh
 
-__all__ = ["CompiledTrainer", "FitResult", "build_mesh", "DATA_AXIS"]
+__all__ = [
+    "CompiledTrainer",
+    "FitResult",
+    "build_mesh",
+    "DATA_AXIS",
+    "initialize_cluster",
+    "global_mesh",
+]

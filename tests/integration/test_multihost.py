@@ -1,0 +1,89 @@
+"""Multi-process (multi-"host") training smoke test.
+
+The reference's multi-worker story is Spark executors on a cluster; ours is
+one JAX process per host joined via ``initialize_cluster``
+(``jax.distributed`` — SURVEY.md §2.4's DCN bootstrap). This test launches
+TWO separate processes, each owning 2 virtual CPU devices, and runs the SAME
+``SparkModel.fit`` in both over the resulting 4-device global mesh — the
+actual cross-process code path (Gloo collectives between processes), not a
+single-process simulation.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import sys
+import numpy as np
+
+from elephas_tpu.parallel import initialize_cluster
+initialize_cluster(coordinator_address="127.0.0.1:%(port)d",
+                   num_processes=2, process_id=int(sys.argv[1]))
+
+import jax
+assert jax.device_count() == 4, jax.device_count()
+assert jax.local_device_count() == 2
+
+import keras
+from elephas_tpu import SparkModel
+from elephas_tpu.data import SparkContext
+from elephas_tpu.utils import to_simple_rdd
+
+rng = np.random.default_rng(0)
+x = rng.normal(size=(256, 10)).astype("float32")
+w = rng.normal(size=(10, 3))
+y = np.eye(3, dtype="float32")[(x @ w).argmax(1)]
+
+keras.utils.set_random_seed(7)
+model = keras.Sequential([
+    keras.layers.Dense(16, activation="relu"),
+    keras.layers.Dense(3, activation="softmax"),
+])
+model.build((None, 10))
+model.compile(optimizer="adam", loss="categorical_crossentropy",
+              metrics=["accuracy"])
+
+sc = SparkContext("local[4]")
+rdd = to_simple_rdd(sc, x, y)
+sm = SparkModel(model, mode="synchronous", num_workers=4)
+sm.fit(rdd, epochs=2, batch_size=16, validation_split=0.0)
+h = sm.training_histories[-1]["loss"]
+assert h[-1] < h[0], h
+print("LOSSES", [round(v, 6) for v in h], flush=True)
+"""
+
+
+@pytest.mark.multihost
+def test_two_process_fit(tmp_path):
+    port = 47123
+    script = tmp_path / "worker.py"
+    script.write_text(_WORKER % {"port": port})
+    env = dict(os.environ)
+    env.update({
+        "PALLAS_AXON_POOL_IPS": "",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "KERAS_BACKEND": "jax",
+        "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+    })
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), str(pid)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in (0, 1)
+    ]
+    outs = [p.communicate(timeout=420)[0] for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+    # SPMD: both processes must observe identical merged training histories
+    lines = [
+        next(l for l in out.splitlines() if l.startswith("LOSSES"))
+        for out in outs
+    ]
+    assert lines[0] == lines[1], lines
