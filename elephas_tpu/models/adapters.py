@@ -232,6 +232,40 @@ class KerasModelAdapter:
 
         return train_step
 
+    def build_grad_step(self, remat: bool = False) -> Callable:
+        """``(tv, ntv, x, y, sw) → (grads, ntv2, stats)`` — gradients of the
+        sample-weighted loss SUM, without applying an update.
+
+        For gradient-synchronous data parallelism: callers sum these grads
+        across workers/devices and divide by the global weight sum, giving
+        exactly the gradient of the global weighted-mean loss — one optimizer
+        step per global batch, identical on every replica. ``stats`` matches
+        :meth:`build_train_step`. All-padding batches leave ``ntv`` unchanged.
+        """
+        model = self.model
+        per_sample_loss = resolve_per_sample_loss(self._require_loss())
+        acc_fn = resolve_accuracy(self.loss_spec) if self.wants_accuracy else None
+
+        def grad_step(tv, ntv, x, y, sw):
+            def _loss(tv_):
+                y_pred, ntv2 = model.stateless_call(tv_, ntv, x, training=True)
+                per = per_sample_loss(y, y_pred)
+                return jnp.sum(per * sw), (ntv2, y_pred)
+
+            if remat:
+                _loss = jax.checkpoint(_loss)
+            (loss_wsum, (ntv2, y_pred)), grads = jax.value_and_grad(
+                _loss, has_aux=True
+            )(tv)
+            wsum = jnp.sum(sw)
+            ntv2 = _tree_where(wsum > 0, ntv2, ntv)
+            acc_sum = (
+                jnp.sum(acc_fn(y, y_pred) * sw) if acc_fn is not None else jnp.zeros(())
+            )
+            return grads, ntv2, (loss_wsum, acc_sum, wsum)
+
+        return grad_step
+
     def build_eval_step(self) -> Callable:
         """``(tv, ntv, x, y, sw) → (loss_wsum, acc_wsum, wsum)``."""
         model = self.model

@@ -66,6 +66,99 @@ def _pad_block(arr: np.ndarray, target_rows: int) -> np.ndarray:
     return np.concatenate([arr, pad], axis=0)
 
 
+# -- helpers shared by the local-training and gradient-sync builders ---------
+
+
+def _make_shuffler(S: int, B: int):
+    """Per-worker epoch shuffle into ``[S, B, ...]`` batch blocks."""
+
+    def shuffled_batches(x_l, y_l, sw_l, key):
+        perm = jax.random.permutation(key, x_l.shape[0])
+        xb = x_l[perm].reshape((S, B) + x_l.shape[1:])
+        yb = y_l[perm].reshape((S, B) + y_l.shape[1:])
+        swb = sw_l[perm].reshape((S, B))
+        return xb, yb, swb
+
+    return shuffled_batches
+
+
+def _make_tile(L: int):
+    return lambda t: jnp.broadcast_to(t[None], (L,) + t.shape).astype(t.dtype)
+
+
+def _seeded_ntv_stack(ntv0, mergeable, L: int):
+    """Tile non-trainable state per local worker. Integer non-mergeable
+    entries are seed-generator state: offset each replica by its global
+    worker id so dropout masks are independent across workers (as the
+    reference's independent executors are), not identical copies."""
+    tile = _make_tile(L)
+    widx = jax.lax.axis_index(DATA_AXIS) * L + jnp.arange(L)
+    stack = []
+    for t, is_m in zip(ntv0, mergeable):
+        tiled = tile(t)
+        if not is_m and jnp.issubdtype(jnp.asarray(t).dtype, jnp.integer):
+            tiled = tiled + widx.reshape(
+                (L,) + (1,) * jnp.asarray(t).ndim
+            ).astype(tiled.dtype)
+        stack.append(tiled)
+    return stack
+
+
+def _merged_ntv_bases(ntv_stack, base_ntv, wvalid, mergeable, denom, kind):
+    """Merge weight-slot ntv entries (BN stats) across workers: per mergeable
+    entry the merged base value, ``None`` for non-mergeable (seed) entries."""
+    out = []
+    for i, is_m in enumerate(mergeable):
+        if not is_m:
+            out.append(None)
+            continue
+        s, b = ntv_stack[i], base_ntv[i]
+        delta = b[None] - s
+        loc = jnp.sum(
+            delta
+            * wvalid.reshape((-1,) + (1,) * (delta.ndim - 1)).astype(delta.dtype),
+            axis=0,
+        )
+        tot = jax.lax.psum(loc, DATA_AXIS)
+        if kind == "mean":
+            tot = tot / denom
+        out.append(b - tot)
+    return out
+
+
+def _psum_weighted_means(stats):
+    """``(loss_wsum, acc_wsum, wsum)`` arrays → global ``{"loss", "accuracy"}``."""
+    loss_ws, acc_ws, wsum = jax.tree_util.tree_map(jnp.sum, stats)
+    loss_sum = jax.lax.psum(loss_ws, DATA_AXIS)
+    acc_sum = jax.lax.psum(acc_ws, DATA_AXIS)
+    w_sum = jnp.maximum(jax.lax.psum(wsum, DATA_AXIS), 1e-9)
+    return {"loss": loss_sum / w_sum, "accuracy": acc_sum / w_sum}
+
+
+def _make_local_eval(eval_step, Sv: int, B: int):
+    """Scan the eval step over a worker's validation block."""
+
+    def local_eval(tv, ntv, xv_l, yv_l, sv_l):
+        xb = xv_l.reshape((Sv, B) + xv_l.shape[1:])
+        yb = yv_l.reshape((Sv, B) + yv_l.shape[1:])
+        svb = sv_l.reshape((Sv, B))
+
+        def step(_, batch):
+            return None, eval_step(tv, ntv, *batch)
+
+        _, stats = jax.lax.scan(step, None, (xb, yb, svb))
+        return jax.tree_util.tree_map(jnp.sum, stats)
+
+    return local_eval
+
+
+def _psum_val_metrics(vstats):
+    vloss = jax.lax.psum(jnp.sum(vstats[0]), DATA_AXIS)
+    vacc = jax.lax.psum(jnp.sum(vstats[1]), DATA_AXIS)
+    vw = jnp.maximum(jax.lax.psum(jnp.sum(vstats[2]), DATA_AXIS), 1e-9)
+    return {"val_loss": vloss / vw, "val_accuracy": vacc / vw}
+
+
 class FitResult:
     """Final weights + Keras-``History``-shaped metrics (+ carryable state)."""
 
@@ -97,6 +190,13 @@ class CompiledTrainer:
         self.mode = mode
         self.frequency = frequency
         self.remat = remat
+        if mode == "synchronous" and frequency == "batch" and merge == "sum":
+            raise ValueError(
+                "mode='synchronous', frequency='batch' is the gradient-"
+                "synchronous schedule: gradients are weight-averaged per "
+                "batch and there is no delta merge, so merge='sum' has no "
+                "meaning here (use merge='auto')."
+            )
         if merge == "auto":
             merge = "mean" if mode == "synchronous" else "sum"
         if merge not in ("mean", "sum"):
@@ -357,6 +457,10 @@ class CompiledTrainer:
     def _build(self, L: int, S: int, B: int, E: int, Sv: int, has_val: bool,
                mergeable: List[bool]):
         """Trace+compile the full multi-epoch training program."""
+        if self.mode == "synchronous" and self.frequency == "batch":
+            return self._build_gradsync(
+                L=L, S=S, B=B, E=E, Sv=Sv, has_val=has_val, mergeable=mergeable
+            )
         adapter = self.adapter
         optimizer = self.optimizer
         train_step = adapter.build_train_step(optimizer, remat=self.remat)
@@ -390,31 +494,16 @@ class CompiledTrainer:
         def merge_ntv(ntv_stack, base_ntv, wvalid, denom):
             """Merge only weight-slot ntv entries (BN stats); seed/counter
             state stays per-worker."""
-            out = []
-            for i, is_m in enumerate(mergeable):
-                if not is_m:
-                    out.append(ntv_stack[i])
-                    continue
-                s, b = ntv_stack[i], base_ntv[i]
-                delta = b[None] - s
-                loc = jnp.sum(
-                    delta
-                    * wvalid.reshape((-1,) + (1,) * (delta.ndim - 1)).astype(delta.dtype),
-                    axis=0,
-                )
-                tot = jax.lax.psum(loc, DATA_AXIS)
-                if merge_kind == "mean":
-                    tot = tot / denom
-                merged = b - tot
-                out.append(jnp.broadcast_to(merged[None], s.shape).astype(s.dtype))
-            return out
+            bases = _merged_ntv_bases(
+                ntv_stack, base_ntv, wvalid, mergeable, denom, merge_kind
+            )
+            return [
+                s if b is None
+                else jnp.broadcast_to(b[None], s.shape).astype(s.dtype)
+                for b, s in zip(bases, ntv_stack)
+            ]
 
-        def shuffled_batches(x_l, y_l, sw_l, key):
-            perm = jax.random.permutation(key, x_l.shape[0])
-            xb = x_l[perm].reshape((S, B) + x_l.shape[1:])
-            yb = y_l[perm].reshape((S, B) + y_l.shape[1:])
-            swb = sw_l[perm].reshape((S, B))
-            return xb, yb, swb
+        shuffled_batches = _make_shuffler(S, B)
 
         def local_epoch(tv, ntv, opt, x_l, y_l, sw_l, key):
             xb, yb, swb = shuffled_batches(x_l, y_l, sw_l, key)
@@ -427,18 +516,8 @@ class CompiledTrainer:
             (tv, ntv, opt), stats = jax.lax.scan(step, (tv, ntv, opt), (xb, yb, swb))
             return tv, ntv, opt, jax.tree_util.tree_map(jnp.sum, stats)
 
-        def local_eval(tv, ntv, xv_l, yv_l, sv_l):
-            xb = xv_l.reshape((Sv, B) + xv_l.shape[1:])
-            yb = yv_l.reshape((Sv, B) + yv_l.shape[1:])
-            svb = sv_l.reshape((Sv, B))
-
-            def step(_, batch):
-                return None, eval_step(tv, ntv, *batch)
-
-            _, stats = jax.lax.scan(step, None, (xb, yb, svb))
-            return jax.tree_util.tree_map(jnp.sum, stats)
-
-        tile = lambda t: jnp.broadcast_to(t[None], (L,) + t.shape).astype(t.dtype)
+        local_eval = _make_local_eval(eval_step, Sv, B)
+        tile = _make_tile(L)
 
         def opt_init_impl(tv0):
             # Per-worker optimizer state stack, identical at init.
@@ -449,17 +528,7 @@ class CompiledTrainer:
             # wvalid [L]; tv0/ntv0 replicated; opt_stack [L, ...] per shard.
             denom = jnp.maximum(jax.lax.psum(jnp.sum(wvalid), DATA_AXIS), 1.0)
             tv_stack = jax.tree_util.tree_map(tile, tv0)
-            # Non-mergeable integer ntv entries are seed-generator state:
-            # offset each replica by its global worker id so dropout masks are
-            # independent across workers (as the reference's independent
-            # executors are), not identical copies.
-            widx = jax.lax.axis_index(DATA_AXIS) * L + jnp.arange(L)
-            ntv_stack = []
-            for t, is_m in zip(ntv0, mergeable):
-                tiled = tile(t)
-                if not is_m and jnp.issubdtype(jnp.asarray(t).dtype, jnp.integer):
-                    tiled = tiled + widx.reshape((L,) + (1,) * jnp.asarray(t).ndim).astype(tiled.dtype)
-                ntv_stack.append(tiled)
+            ntv_stack = _seeded_ntv_stack(ntv0, mergeable, L)
             base_tv, base_ntv = tv0, list(ntv0)
 
             def epoch_body(carry, e):
@@ -523,23 +592,12 @@ class CompiledTrainer:
                         ]
 
                 # -- epoch metrics (weighted sums → psum → global means)
-                loss_ws, acc_ws, wsum = stats
-                loss_sum = jax.lax.psum(jnp.sum(loss_ws), DATA_AXIS)
-                acc_sum = jax.lax.psum(jnp.sum(acc_ws), DATA_AXIS)
-                w_sum = jnp.maximum(jax.lax.psum(jnp.sum(wsum), DATA_AXIS), 1e-9)
-                metrics = {
-                    "loss": loss_sum / w_sum,
-                    "accuracy": acc_sum / w_sum,
-                }
+                metrics = _psum_weighted_means(stats)
                 if has_val:
                     vstats = jax.vmap(
                         lambda tv, ntv, a, b, c: local_eval(tv, ntv, a, b, c)
                     )(tv_stack, ntv_stack, xv, yv, sv)
-                    vloss = jax.lax.psum(jnp.sum(vstats[0]), DATA_AXIS)
-                    vacc = jax.lax.psum(jnp.sum(vstats[1]), DATA_AXIS)
-                    vw = jnp.maximum(jax.lax.psum(jnp.sum(vstats[2]), DATA_AXIS), 1e-9)
-                    metrics["val_loss"] = vloss / vw
-                    metrics["val_accuracy"] = vacc / vw
+                    metrics.update(_psum_val_metrics(vstats))
 
                 return (tv_stack, ntv_stack, opt_stack, base_tv, base_ntv), metrics
 
@@ -581,4 +639,107 @@ class CompiledTrainer:
         # Donate the optimizer-state stack: it is consumed and returned every
         # call, so aliasing its buffers halves its HBM footprint (arg 2 =
         # opt_stack in fit_impl's signature).
+        return jax.jit(shard_fit, donate_argnums=(2,)), jax.jit(shard_opt_init)
+
+    # ------------------------------------------------------------------
+    def _build_gradsync(self, L: int, S: int, B: int, E: int, Sv: int,
+                        has_val: bool, mergeable: List[bool]):
+        """Gradient-synchronous DP-SGD: ``mode='synchronous',
+        frequency='batch'``.
+
+        The canonical TPU data-parallel schedule (SURVEY.md §7.1.3's "fast
+        path"), a deliberate extension beyond the reference's three schedules:
+        per batch, every worker computes gradients of its sample-weighted loss
+        SUM on the SHARED parameters; the sums ride one ``psum`` over ICI and
+        one optimizer step applies their weighted mean. Parameters never
+        diverge, so there is no delta merge at all — strictly better
+        convergence than local-training schedules at the cost of one
+        collective per batch (cheap on ICI, exactly what the hardware is for).
+        BatchNorm statistics stay per-worker during the fit and merge once at
+        the end; dropout masks stay independent per worker.
+        """
+        adapter = self.adapter
+        optimizer = self.optimizer
+        grad_step = adapter.build_grad_step(remat=self.remat)
+        eval_step = adapter.build_eval_step()
+        shuffled_batches = _make_shuffler(S, B)
+        local_eval = _make_local_eval(eval_step, Sv, B)
+
+        def opt_init_impl(tv0):
+            return optimizer.init(tv0)  # ONE state, replicated everywhere
+
+        def fit_impl(tv0, ntv0, opt_state, x, y, sw, xv, yv, sv, keys, wvalid):
+            denom = jnp.maximum(jax.lax.psum(jnp.sum(wvalid), DATA_AXIS), 1.0)
+            ntv_stack = _seeded_ntv_stack(ntv0, mergeable, L)
+            tv = tv0
+
+            def epoch_body(carry, e):
+                tv, ntv_stack, opt = carry
+                ekeys = jax.vmap(lambda k: jax.random.fold_in(k, e))(keys)
+                xb, yb, swb = jax.vmap(shuffled_batches)(x, y, sw, ekeys)
+                xb = jnp.swapaxes(xb, 0, 1)  # [S, L, B, ...]
+                yb = jnp.swapaxes(yb, 0, 1)
+                swb = jnp.swapaxes(swb, 0, 1)
+
+                def bstep(carry, batch):
+                    tv, ntv_stack, opt = carry
+                    grads, ntv_stack, stats = jax.vmap(
+                        grad_step, in_axes=(None, 0, 0, 0, 0)
+                    )(tv, ntv_stack, *batch)
+                    gsum = jax.tree_util.tree_map(
+                        lambda g: jnp.sum(g, axis=0), grads
+                    )
+                    gtot = jax.lax.psum(gsum, DATA_AXIS)
+                    wtot = jnp.maximum(
+                        jax.lax.psum(jnp.sum(stats[2]), DATA_AXIS), 1e-9
+                    )
+                    ghat = jax.tree_util.tree_map(lambda g: g / wtot, gtot)
+                    updates, opt = optimizer.update(ghat, opt, tv)
+                    tv = jax.tree_util.tree_map(jnp.add, tv, updates)
+                    return (tv, ntv_stack, opt), jax.tree_util.tree_map(
+                        jnp.sum, stats
+                    )
+
+                (tv, ntv_stack, opt), stats = jax.lax.scan(
+                    bstep, (tv, ntv_stack, opt), (xb, yb, swb)
+                )
+                metrics = _psum_weighted_means(stats)
+                if has_val:
+                    vstats = jax.vmap(
+                        lambda ntv_l, a, b, c: local_eval(tv, ntv_l, a, b, c)
+                    )(ntv_stack, xv, yv, sv)
+                    metrics.update(_psum_val_metrics(vstats))
+                return (tv, ntv_stack, opt), metrics
+
+            (tv, ntv_stack, opt_state), metrics = jax.lax.scan(
+                epoch_body, (tv, ntv_stack, opt_state), jnp.arange(E)
+            )
+
+            # end-of-fit BN-stats merge (mean of per-worker deltas)
+            bases = _merged_ntv_bases(
+                ntv_stack, list(ntv0), wvalid, mergeable, denom, "mean"
+            )
+            ntv_mergeable_out = [b for b in bases if b is not None]
+            return tv, ntv_mergeable_out, opt_state, metrics
+
+        mesh = self.mesh
+        pspec_rep = P()
+        pspec_data = P(DATA_AXIS)
+        # One shared optimizer state: replicated in AND out (unlike the
+        # per-worker stacks of the local-training schedules).
+        shard_fit = jax.shard_map(
+            fit_impl,
+            mesh=mesh,
+            in_specs=(
+                pspec_rep, pspec_rep, pspec_rep, pspec_data, pspec_data,
+                pspec_data, pspec_data, pspec_data, pspec_data, pspec_data,
+                pspec_data,
+            ),
+            out_specs=(pspec_rep, pspec_rep, pspec_rep, pspec_rep),
+            check_vma=False,
+        )
+        shard_opt_init = jax.shard_map(
+            opt_init_impl, mesh=mesh, in_specs=(pspec_rep,),
+            out_specs=pspec_rep, check_vma=False,
+        )
         return jax.jit(shard_fit, donate_argnums=(2,)), jax.jit(shard_opt_init)

@@ -46,7 +46,8 @@ def model(x_train, y_train, x_test, y_test):
 def main():
     sc = SparkContext(master="local[4]", appName="hyperparam")
     hp = HyperParamModel(sc, num_workers=4)
-    best = hp.minimize(model=model, data=data, max_evals=3)
+    best = hp.minimize(model=model, data=data,
+                       max_evals=int(os.environ.get("EX_EPOCHS", 3)))
     x_tr, y_tr, x_te, y_te = data()
     preds = best.predict(x_te, verbose=0)
     acc = float((preds.argmax(1) == y_te.argmax(1)).mean())

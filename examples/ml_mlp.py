@@ -23,7 +23,8 @@ def main():
     spark = SparkSession.builder.master(f"local[{n_workers}]").appName(
         "ml_mlp"
     ).getOrCreate()
-    (x_train, y_train), (x_test, y_test) = load_mnist(n_train=8192, n_test=1024)
+    n_train = int(os.environ.get("EX_SAMPLES", 8192))
+    (x_train, y_train), (x_test, y_test) = load_mnist(n_train=n_train, n_test=1024)
 
     df = spark.createDataFrame(
         [Row(features=Vectors.dense(x.astype("float64")),
@@ -43,7 +44,7 @@ def main():
     estimator.set_categorical(True)
     estimator.set_nb_classes(10)
     estimator.set_num_workers(n_workers)
-    estimator.set_epochs(3)
+    estimator.set_epochs(int(os.environ.get("EX_EPOCHS", 3)))
     estimator.set_batch_size(64)
     estimator.set_validation_split(0.1)
     estimator.set_mode("synchronous")

@@ -48,7 +48,10 @@ def main():
     spark = SparkSession.builder.master(f"local[{n_workers}]").appName(
         "imdb_lstm"
     ).getOrCreate()
-    (x_train, y_train), (x_test, y_test) = load_imdb(maxlen=MAXLEN, vocab=VOCAB)
+    n_train = int(os.environ.get("EX_SAMPLES", 2048))
+    (x_train, y_train), (x_test, y_test) = load_imdb(
+        n_train=n_train, maxlen=MAXLEN, vocab=VOCAB
+    )
 
     rows = [
         Row(features=Vectors.dense(x.astype("float64")), label=float(y[0]))

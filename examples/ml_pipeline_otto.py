@@ -36,7 +36,7 @@ def main():
     spark = SparkSession.builder.master(f"local[{n_workers}]").appName(
         "otto"
     ).getOrCreate()
-    x, targets = load_otto()
+    x, targets = load_otto(n=int(os.environ.get("EX_SAMPLES", 4096)))
 
     df = spark.createDataFrame(
         [Row(raw_features=Vectors.dense(xi.astype("float64")), target=t)
@@ -61,7 +61,7 @@ def main():
     estimator.set_features_col("scaled_features")
     estimator.set_label_col("label")
     estimator.set_num_workers(n_workers)
-    estimator.set_epochs(4)
+    estimator.set_epochs(int(os.environ.get("EX_EPOCHS", 4)))
     estimator.set_batch_size(64)
     estimator.set_validation_split(0.0)
     estimator.set_mode("synchronous")

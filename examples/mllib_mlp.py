@@ -36,7 +36,8 @@ def boston_regression(sc, n_workers):
     model.compile(optimizer="adam", loss="mse")
     mllib_model = SparkMLlibModel(model, mode="synchronous",
                                   num_workers=n_workers)
-    mllib_model.fit(lp_rdd, epochs=20, batch_size=32, validation_split=0.0,
+    epochs = int(os.environ.get("EX_EPOCHS", 20))
+    mllib_model.fit(lp_rdd, epochs=epochs, batch_size=32, validation_split=0.0,
                     categorical=False)
     pred = mllib_model.predict(Vectors.dense(x[0].astype("float64")))
     print(f"Boston: predicted {float(pred[0]) * y_std + y_mean:.1f}, "
@@ -57,7 +58,8 @@ def iris_classification(sc, n_workers):
                   metrics=["accuracy"])
     mllib_model = SparkMLlibModel(model, mode="synchronous",
                                   num_workers=min(n_workers, 4))
-    mllib_model.fit(lp_rdd, epochs=30, batch_size=16, validation_split=0.0,
+    epochs = int(os.environ.get("EX_EPOCHS", 30))
+    mllib_model.fit(lp_rdd, epochs=epochs, batch_size=16, validation_split=0.0,
                     categorical=True, nb_classes=3)
     preds = mllib_model.predict(
         Matrices.dense(len(x), 4, x.astype("float64").flatten(order="F"))

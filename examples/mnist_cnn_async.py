@@ -45,7 +45,9 @@ def main():
 
     n_workers = jax.local_device_count()
     sc = SparkContext(master=f"local[{n_workers}]", appName="mnist_cnn_async")
-    (x_train, y_train), (x_test, y_test) = load_mnist(n_train=8192, n_test=1024)
+    n_train = int(os.environ.get("EX_SAMPLES", 8192))
+    epochs = int(os.environ.get("EX_EPOCHS", 3))
+    (x_train, y_train), (x_test, y_test) = load_mnist(n_train=n_train, n_test=1024)
     rdd = to_simple_rdd(sc, x_train, y_train)
 
     for mode, ps in [("asynchronous", "jax"), ("hogwild", "jax"),
@@ -55,7 +57,7 @@ def main():
             model, mode=mode, frequency="epoch", parameter_server_mode=ps,
             num_workers=n_workers, port=4100, merge="mean",
         )
-        spark_model.fit(rdd, epochs=3, batch_size=64, verbose=0,
+        spark_model.fit(rdd, epochs=epochs, batch_size=64, verbose=0,
                         validation_split=0.0)
         loss, acc = spark_model.evaluate(x_test, y_test)
         print(f"{mode:12s}/{ps:6s}: test loss={loss:.4f} acc={acc:.4f}")

@@ -30,7 +30,9 @@ def main():
 
     n_workers = jax.local_device_count()
     sc = SparkContext(master=f"local[{n_workers}]", appName="mnist_mlp")
-    (x_train, y_train), (x_test, y_test) = load_mnist()
+    n_train = int(os.environ.get("EX_SAMPLES", 16384))
+    epochs = int(os.environ.get("EX_EPOCHS", 5))
+    (x_train, y_train), (x_test, y_test) = load_mnist(n_train=n_train)
 
     model = keras.Sequential(
         [
@@ -47,7 +49,7 @@ def main():
 
     rdd = to_simple_rdd(sc, x_train, y_train)
     spark_model = SparkModel(model, mode="synchronous", num_workers=n_workers)
-    spark_model.fit(rdd, epochs=5, batch_size=128, verbose=1,
+    spark_model.fit(rdd, epochs=epochs, batch_size=128, verbose=1,
                     validation_split=0.1)
 
     loss, acc = spark_model.evaluate(x_test, y_test)

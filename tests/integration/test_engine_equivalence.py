@@ -35,6 +35,50 @@ def _model(d=6, c=3, seed=1):
     return m
 
 
+def test_gradsync_rejects_sum_merge():
+    """Gradient-sync has no delta merge — merge='sum' must be rejected."""
+    with pytest.raises(ValueError, match="gradient-synchronous"):
+        CompiledTrainer(
+            KerasModelAdapter(_model()), build_mesh(1),
+            mode="synchronous", frequency="batch", merge="sum",
+        )
+
+
+def test_gradsync_step_equals_global_batch_sgd():
+    """One gradient-synchronous step (mode='synchronous', frequency='batch')
+    must equal EXACTLY one SGD step on the concatenated global batch: the
+    per-worker weighted grad sums psum to the global weighted-mean gradient."""
+    import jax
+    import jax.numpy as jnp
+
+    x, y = _problem(n=64)
+    blocks = [(x[i * 16:(i + 1) * 16], y[i * 16:(i + 1) * 16]) for i in range(4)]
+
+    em = _model(seed=3)
+    adapter = KerasModelAdapter(em)
+    tv0, ntv0 = adapter.state_values()
+    tv0 = [np.asarray(t) for t in tv0]
+
+    # expected: grad of the global-mean loss over all 64 samples, lr 0.1
+    grad_step = adapter.build_grad_step()
+    grads, _, (loss_wsum, _, wsum) = jax.jit(grad_step)(
+        tv0, ntv0, x, y, jnp.ones((64,), jnp.float32)
+    )
+    expected = [np.asarray(t) - 0.1 * np.asarray(g) / 64.0
+                for t, g in zip(tv0, grads)]
+
+    trainer = CompiledTrainer(
+        KerasModelAdapter(em), build_mesh(4), mode="synchronous",
+        frequency="batch",
+    )
+    trainer.fit(blocks, epochs=1, batch_size=16, validation_split=0.0)
+    got = [v for v in trainer.adapter.state_values()[0]]
+    for e, g in zip(expected, got):
+        assert np.allclose(e, np.asarray(g), atol=1e-5), (
+            np.abs(e - np.asarray(g)).max()
+        )
+
+
 def test_single_worker_tracks_keras_fit():
     x, y = _problem()
     # keras reference run

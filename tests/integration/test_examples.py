@@ -1,0 +1,46 @@
+"""Example-script smoke tests.
+
+The reference's examples double as its integration surface (SURVEY.md §4 —
+CI runs them nowhere, and they rot). Here each example runs as a subprocess
+on the CPU mesh with tiny ``EX_SAMPLES``/``EX_EPOCHS`` overrides, asserting
+it exits cleanly — the same scripts scale back up to real sizes unchanged.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EXAMPLES = [
+    "mnist_mlp_spark.py",
+    "mnist_cnn_async.py",
+    "mllib_mlp.py",
+    "ml_mlp.py",
+    "ml_pipeline_otto.py",
+    "ml_pipeline_imdb_lstm.py",
+    "hyperparam_optimization.py",
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("script", EXAMPLES)
+def test_example_runs(script):
+    env = dict(os.environ)
+    env.update({
+        "PALLAS_AXON_POOL_IPS": "",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "KERAS_BACKEND": "jax",
+        # > batch_size(128) per each of the 8 workers, or the reference's
+        # skip-small-partitions quirk empties the fit
+        "EX_SAMPLES": "2048",
+        "EX_EPOCHS": "1",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples", script)],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, (proc.stdout + proc.stderr)[-3000:]

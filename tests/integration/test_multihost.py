@@ -56,9 +56,17 @@ print("LOSSES", [round(v, 6) for v in h], flush=True)
 """
 
 
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 @pytest.mark.multihost
 def test_two_process_fit(tmp_path):
-    port = 47123
+    port = _free_port()
     script = tmp_path / "worker.py"
     script.write_text(_WORKER % {"port": port})
     env = dict(os.environ)
@@ -78,7 +86,12 @@ def test_two_process_fit(tmp_path):
         )
         for pid in (0, 1)
     ]
-    outs = [p.communicate(timeout=420)[0] for p in procs]
+    try:
+        outs = [p.communicate(timeout=420)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out[-2000:]
     # SPMD: both processes must observe identical merged training histories

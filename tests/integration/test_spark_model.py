@@ -35,6 +35,7 @@ def rdd(spark_context, toy_classification):
 MATRIX = [
     # (mode, ps_mode, frequency)
     ("synchronous", "jax", "epoch"),
+    ("synchronous", "jax", "batch"),  # gradient-sync DP-SGD (TPU extension)
     ("asynchronous", "jax", "epoch"),
     ("asynchronous", "jax", "batch"),
     ("hogwild", "jax", "epoch"),
