@@ -1,8 +1,10 @@
-"""Custom TPU ops (Pallas kernels).
+"""Custom TPU ops.
 
 ``pallas_ops`` holds the fused classification-loss kernel (used automatically
-on TPU via ``models.losses``); jnp reference implementations double as CPU
-fallbacks and test oracles.
+on TPU via ``models.losses``); ``ring_attention`` provides sequence-parallel
+exact attention over the mesh (an explicitly-labeled extension — the
+reference has no long-context support, SURVEY.md §5.7). jnp reference
+implementations double as CPU fallbacks and test oracles.
 """
 
 from .pallas_ops import (
@@ -10,9 +12,12 @@ from .pallas_ops import (
     fused_xent_from_logits,
     xent_from_logits_reference,
 )
+from .ring_attention import attention_reference, ring_attention
 
 __all__ = [
     "categorical_crossentropy_from_logits",
     "fused_xent_from_logits",
     "xent_from_logits_reference",
+    "ring_attention",
+    "attention_reference",
 ]
