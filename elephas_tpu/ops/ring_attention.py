@@ -130,6 +130,8 @@ def ring_attention(q, k, v, mesh=None, causal: bool = False,
     key = (mesh, axis_name, causal)
     fn = _COMPILED.get(key)
     if fn is None:
+        if len(_COMPILED) >= 16:  # bound the executable cache
+            _COMPILED.pop(next(iter(_COMPILED)))
         fn = jax.jit(
             jax.shard_map(
                 partial(_ring_attention_local, causal=causal,

@@ -1,12 +1,26 @@
 from .distributed import global_mesh, initialize_cluster
 from .engine import CompiledTrainer, FitResult
 from .mesh import DATA_AXIS, build_mesh
+from .tensor import (
+    MODEL_AXIS,
+    TensorParallelMLP,
+    build_mesh2d,
+    build_tp_train_step,
+    column_parallel_dense,
+    row_parallel_dense,
+)
 
 __all__ = [
     "CompiledTrainer",
     "FitResult",
     "build_mesh",
     "DATA_AXIS",
+    "MODEL_AXIS",
+    "build_mesh2d",
+    "TensorParallelMLP",
+    "build_tp_train_step",
+    "column_parallel_dense",
+    "row_parallel_dense",
     "initialize_cluster",
     "global_mesh",
 ]

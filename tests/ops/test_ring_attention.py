@@ -36,8 +36,10 @@ def test_indivisible_sequence_rejected():
         ring_attention(q, k, v, mesh=build_mesh(8))
 
 
-def test_gradients_flow():
-    """The op must be differentiable end-to-end (training usage)."""
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_flow(causal):
+    """Differentiable end-to-end (training usage), incl. the causal backward
+    path through the -inf masking and isneginf guards."""
     import jax
     import jax.numpy as jnp
 
@@ -45,10 +47,10 @@ def test_gradients_flow():
     mesh = build_mesh(8)
 
     def loss_ring(q):
-        return jnp.sum(ring_attention(q, k, v, mesh=mesh) ** 2)
+        return jnp.sum(ring_attention(q, k, v, mesh=mesh, causal=causal) ** 2)
 
     def loss_ref(q):
-        return jnp.sum(attention_reference(q, k, v) ** 2)
+        return jnp.sum(attention_reference(q, k, v, causal=causal) ** 2)
 
     g_ring = np.asarray(jax.grad(loss_ring)(jnp.asarray(q)))
     g_ref = np.asarray(jax.grad(loss_ref)(jnp.asarray(q)))
