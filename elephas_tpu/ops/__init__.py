@@ -1,10 +1,11 @@
 """Custom TPU ops.
 
 ``pallas_ops`` holds the fused classification-loss kernel (used automatically
-on TPU via ``models.losses``); ``ring_attention`` provides sequence-parallel
-exact attention over the mesh (an explicitly-labeled extension — the
-reference has no long-context support, SURVEY.md §5.7). jnp reference
-implementations double as CPU fallbacks and test oracles.
+on TPU via ``models.losses``); ``ring_attention`` and ``ulysses`` provide
+the two canonical sequence-parallel exact-attention schedules over the mesh
+(explicitly-labeled extensions — the reference has no long-context support,
+SURVEY.md §5.7). jnp reference implementations double as CPU fallbacks and
+test oracles.
 """
 
 from .pallas_ops import (
@@ -13,6 +14,7 @@ from .pallas_ops import (
     xent_from_logits_reference,
 )
 from .ring_attention import attention_reference, ring_attention
+from .ulysses import ulysses_attention
 
 __all__ = [
     "categorical_crossentropy_from_logits",
@@ -20,4 +22,5 @@ __all__ = [
     "xent_from_logits_reference",
     "ring_attention",
     "attention_reference",
+    "ulysses_attention",
 ]

@@ -105,37 +105,25 @@ def _ring_attention_local(q, k, v, causal: bool, axis_name: str):
 _COMPILED = {}
 
 
-def ring_attention(q, k, v, mesh=None, causal: bool = False,
-                   axis_name: str = DATA_AXIS):
-    """Exact attention over sequences sharded across a mesh axis.
-
-    ``q``/``k``/``v``: ``[B, T, H, D]`` with ``T`` divisible by the ring size
-    (the ``axis_name`` extent of ``mesh``). Inputs may be host arrays (they
-    are sharded along ``T``) or already sharded. Equals
-    :func:`attention_reference` on the gathered sequence; bf16 inputs
-    accumulate in float32. Compiled executables are cached per
-    (mesh, axis, causal) — shapes/dtypes hit jit's own cache.
-    """
+def sharded_seq_attention(tag: str, local_fn, mesh, axis_name: str,
+                          causal: bool, q, k, v):
+    """Shared harness for the sequence-parallel attention schedules (ring,
+    Ulysses): shard ``q``/``k``/``v`` along the sequence dim over
+    ``axis_name``, run ``local_fn`` (a per-shard body taking
+    ``causal``/``axis_name`` kwargs) inside ``shard_map``, and cache the
+    compiled executable per ``(tag, mesh, axis, causal)`` — shapes/dtypes
+    hit jit's own cache; the dict is FIFO-bounded."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    if mesh is None:
-        from ..parallel.mesh import build_mesh
-
-        mesh = build_mesh()
-    p = mesh.shape[axis_name]  # ring size = this axis, not the whole mesh
-    t = q.shape[1]
-    if t % p:
-        raise ValueError(f"sequence length {t} not divisible by ring size {p}")
     spec = P(None, axis_name)  # shard the sequence dim
-    key = (mesh, axis_name, causal)
+    key = (tag, mesh, axis_name, causal)
     fn = _COMPILED.get(key)
     if fn is None:
         if len(_COMPILED) >= 16:  # bound the executable cache
             _COMPILED.pop(next(iter(_COMPILED)))
         fn = jax.jit(
             jax.shard_map(
-                partial(_ring_attention_local, causal=causal,
-                        axis_name=axis_name),
+                partial(local_fn, causal=causal, axis_name=axis_name),
                 mesh=mesh,
                 in_specs=(spec, spec, spec),
                 out_specs=spec,
@@ -146,3 +134,26 @@ def ring_attention(q, k, v, mesh=None, causal: bool = False,
     shard = NamedSharding(mesh, spec)
     q, k, v = (jax.device_put(a, shard) for a in (q, k, v))
     return fn(q, k, v)
+
+
+def ring_attention(q, k, v, mesh=None, causal: bool = False,
+                   axis_name: str = DATA_AXIS):
+    """Exact attention over sequences sharded across a mesh axis.
+
+    ``q``/``k``/``v``: ``[B, T, H, D]`` with ``T`` divisible by the ring size
+    (the ``axis_name`` extent of ``mesh``). Inputs may be host arrays (they
+    are sharded along ``T``) or already sharded. Equals
+    :func:`attention_reference` on the gathered sequence; bf16 inputs
+    accumulate in float32.
+    """
+    if mesh is None:
+        from ..parallel.mesh import build_mesh
+
+        mesh = build_mesh()
+    p = mesh.shape[axis_name]  # ring size = this axis, not the whole mesh
+    t = q.shape[1]
+    if t % p:
+        raise ValueError(f"sequence length {t} not divisible by ring size {p}")
+    return sharded_seq_attention(
+        "ring", _ring_attention_local, mesh, axis_name, causal, q, k, v
+    )

@@ -1,6 +1,19 @@
 from .distributed import global_mesh, initialize_cluster
 from .engine import CompiledTrainer, FitResult
+from .expert import (
+    EXPERT_AXIS,
+    MoEFeedForward,
+    build_ep_train_step,
+    build_mesh_ep,
+)
 from .mesh import DATA_AXIS, build_mesh
+from .pipeline import (
+    PIPE_AXIS,
+    PipelineDenseStack,
+    build_mesh_pp,
+    build_pp_train_step,
+    pipeline_apply,
+)
 from .tensor import (
     MODEL_AXIS,
     TensorParallelMLP,
@@ -21,6 +34,15 @@ __all__ = [
     "build_tp_train_step",
     "column_parallel_dense",
     "row_parallel_dense",
+    "EXPERT_AXIS",
+    "build_mesh_ep",
+    "MoEFeedForward",
+    "build_ep_train_step",
+    "PIPE_AXIS",
+    "build_mesh_pp",
+    "PipelineDenseStack",
+    "build_pp_train_step",
+    "pipeline_apply",
     "initialize_cluster",
     "global_mesh",
 ]

@@ -1,0 +1,303 @@
+"""Expert parallelism: mixture-of-experts FFN over an ``"expert"`` mesh axis.
+
+EXTENSION BEYOND THE REFERENCE. Expert parallelism is "explicitly ABSENT"
+from the reference (SURVEY.md §2.3) — every executor holds the complete
+model. This module scales *parameter count* past one chip the MoE way
+(GShard, Lepikhin et al. 2020; Switch, Fedus et al. 2021): ``E`` feed-forward
+experts are sharded over an ``"expert"`` mesh axis, a learned router sends
+each token to its top-k experts, and the token blocks travel to the experts'
+devices and back via two ``all_to_all``s — active FLOPs per token stay
+constant while total parameters scale with the mesh.
+
+Dispatch is the GShard einsum formulation: a ``[N, E, C]`` one-hot dispatch
+tensor (capacity ``C`` slots per expert) gathers token blocks
+``[E, C, D]``, the expert-axis ``all_to_all`` re-shards E→local /
+gathers source shards, experts run as one vmapped batched FFN (a single
+``[E/P, P·C, D]`` MXU-friendly matmul per projection — no scalar routing
+loops anywhere), and the transpose ``all_to_all`` + combine einsum scatter
+the outputs home. Tokens beyond an expert's capacity are dropped (their
+combine weight is zero → they pass through the residual path untouched);
+the oracle (:meth:`MoEFeedForward.apply_reference`) reproduces the same
+dispatch math bit-for-bit on one device, which is what the tests check.
+
+Token sharding: the leading token dim may be sharded over BOTH the data and
+expert axes (``P(("data", "expert"))``) — dp groups and expert groups then
+carry disjoint token blocks, and :func:`build_ep_train_step` restores every
+gradient invariant with the minimal collectives (router grads psum over both
+axes, expert grads over ``"data"`` only).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import DATA_AXIS, build_mesh_2axis
+from .param_utils import gather_host, glorot, make_opt_init, shard_by_specs
+
+EXPERT_AXIS = "expert"
+
+
+def build_mesh_ep(data: Optional[int] = None, expert: int = 1,
+                  devices: Optional[Sequence] = None) -> Mesh:
+    """A 2-D ``("data", "expert")`` mesh; ``expert`` = expert-parallel degree."""
+    return build_mesh_2axis(EXPERT_AXIS, data=data, second=expert,
+                            devices=devices)
+
+
+def _top_k_dispatch(gates, capacity: int, k: int):
+    """GShard top-k dispatch from router probabilities.
+
+    ``gates`` ``[N, E]`` (softmax rows) → ``(dispatch [N, E, C] one-hot,
+    combine [N, E, C] weights, aux_stats)``. Slots are claimed in token
+    order, k-th choices queueing behind all (k-1)-th choices (the GShard
+    priority rule), so the result is deterministic and oracle-reproducible.
+    Combine weights renormalize over the token's *kept* choices.
+    """
+    n, e = gates.shape
+    masks = []
+    g = gates
+    for _ in range(k):
+        idx = jnp.argmax(g, axis=-1)
+        m = jax.nn.one_hot(idx, e, dtype=gates.dtype)
+        masks.append(m)
+        g = g * (1.0 - m)  # exclude chosen expert from the next round
+
+    # capacity positions: k-th choices come after all earlier choices
+    pos, counts = [], jnp.zeros((e,), gates.dtype)
+    for m in masks:
+        p_ = jnp.cumsum(m, axis=0) - m + counts[None, :]
+        pos.append(p_)
+        counts = counts + jnp.sum(m, axis=0)
+
+    dispatch = jnp.zeros((n, e, capacity), gates.dtype)
+    combine_w = jnp.zeros((n, e), gates.dtype)
+    for m, p_ in zip(masks, pos):
+        keep = m * (p_ < capacity).astype(gates.dtype)
+        slot = jnp.sum(p_ * keep, axis=-1).astype(jnp.int32)  # [N]
+        dispatch = dispatch + keep[:, :, None] * jax.nn.one_hot(
+            slot, capacity, dtype=gates.dtype
+        )[:, None, :]
+        combine_w = combine_w + keep * gates
+    denom = jnp.maximum(jnp.sum(combine_w, axis=-1, keepdims=True), 1e-9)
+    combine = (combine_w / denom)[:, :, None] * dispatch
+    # aux-loss ingredients (Switch eq. 4): per-expert dispatch counts of the
+    # FIRST choice and summed router probs, plus the token count.
+    aux = (jnp.sum(masks[0], axis=0), jnp.sum(gates, axis=0),
+           jnp.asarray(float(n), gates.dtype))
+    return dispatch, combine, aux
+
+
+class MoEFeedForward:
+    """Top-k routed expert FFN (``D → F → D`` per expert, relu).
+
+    ``capacity_factor`` sizes each expert's buffer PER SOURCE SHARD as
+    ``ceil(cf · k · N_shard / E)`` (``N_shard`` = that shard's token count),
+    so an expert's total slots across the group are ``≈ cf · k · N_group / E``
+    — the GShard budget, paid as ``P`` independent per-shard quotas (slightly
+    laxer than one global cumsum, but all_to_all-local: no cross-shard slot
+    coordination). :meth:`init` returns FULL host params; :meth:`specs`
+    shards the expert stacks over ``"expert"`` and replicates the router.
+    """
+
+    def __init__(self, d_model: int, d_ff: int, n_experts: int, k: int = 2,
+                 capacity_factor: float = 1.25):
+        if n_experts < k:
+            raise ValueError(f"need n_experts >= k, got {n_experts} < {k}")
+        self.d_model = d_model
+        self.d_ff = d_ff
+        self.n_experts = n_experts
+        self.k = k
+        self.capacity_factor = capacity_factor
+
+    def param_shapes(self) -> Dict[str, jax.ShapeDtypeStruct]:
+        """Full (unsharded) shape/dtype per param — the shape-only source for
+        :meth:`init` and the train-step builder's optimizer-state specs."""
+        E, D, F = self.n_experts, self.d_model, self.d_ff
+        return {
+            "wg": jax.ShapeDtypeStruct((D, E), jnp.float32),
+            "w1": jax.ShapeDtypeStruct((E, D, F), jnp.float32),
+            "b1": jax.ShapeDtypeStruct((E, F), jnp.float32),
+            "w2": jax.ShapeDtypeStruct((E, F, D), jnp.float32),
+            "b2": jax.ShapeDtypeStruct((E, D), jnp.float32),
+        }
+
+    def init(self, seed: int = 0) -> Dict[str, np.ndarray]:
+        rng = np.random.default_rng(seed)
+        return {
+            name: glorot(rng, *sds.shape, dtype=sds.dtype)
+            if name.startswith("w") else np.zeros(sds.shape, sds.dtype)
+            for name, sds in self.param_shapes().items()
+        }
+
+    def specs(self) -> Dict[str, P]:
+        return {
+            "wg": P(),
+            "w1": P(EXPERT_AXIS), "b1": P(EXPERT_AXIS),
+            "w2": P(EXPERT_AXIS), "b2": P(EXPERT_AXIS),
+        }
+
+    def shard_params(self, mesh: Mesh, params: Dict[str, Any]) -> Dict[str, Any]:
+        return shard_by_specs(mesh, self.specs(), params)
+
+    def gather_params(self, params: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        return gather_host(params)
+
+    def capacity(self, n_shard: int) -> int:
+        """Per-(expert, source-shard) slot count for ``n_shard`` local tokens."""
+        return max(
+            1, int(math.ceil(self.capacity_factor * self.k * n_shard
+                             / self.n_experts))
+        )
+
+    @staticmethod
+    def _expert_ffn(w1, b1, w2, b2, x):
+        """One expert's FFN over its ``[C, D]`` block (vmapped over E)."""
+        h = jax.nn.relu(jnp.dot(x, w1) + b1)
+        return jnp.dot(h, w2) + b2
+
+    def apply(self, params: Dict[str, Any], x, axis_name: str = EXPERT_AXIS):
+        """Forward INSIDE shard_map. ``x``: local tokens ``[N_l, D]``;
+        expert stacks in ``params`` are local ``[E/P, ...]`` shards.
+        Returns ``(y [N_l, D], aux_loss scalar)`` — aux is the Switch
+        load-balancing loss computed from group-global counts (psummed over
+        ``axis_name``), so it equals the oracle's value exactly."""
+        p = jax.lax.axis_size(axis_name)
+        n_l = x.shape[0]
+        cap = self.capacity(n_l)
+        gates = jax.nn.softmax(jnp.dot(x, params["wg"]), axis=-1)
+        dispatch, combine, (c1, gsum, ntok) = _top_k_dispatch(
+            gates, cap, self.k
+        )
+        # [N_l, E, C] × [N_l, D] → [E, C, D]
+        blocks = jnp.einsum("nec,nd->ecd", dispatch, x)
+        # E→local experts, gather the P source shards' slots:
+        # [E, C, D] → [E/P, P·C, D]
+        blocks = jax.lax.all_to_all(
+            blocks, axis_name, split_axis=0, concat_axis=1, tiled=True
+        )
+        out = jax.vmap(self._expert_ffn)(
+            params["w1"], params["b1"], params["w2"], params["b2"], blocks
+        )
+        # transpose re-shard: [E/P, P·C, D] → [E, C, D]
+        out = jax.lax.all_to_all(
+            out, axis_name, split_axis=1, concat_axis=0, tiled=True
+        )
+        y = jnp.einsum("nec,ecd->nd", combine, out)
+        # Switch aux loss on group-global stats: E · Σ_e f_e · p_e
+        c1 = jax.lax.psum(c1, axis_name)
+        gsum = jax.lax.psum(gsum, axis_name)
+        nt = jax.lax.psum(ntok, axis_name)
+        aux = self.n_experts * jnp.sum((c1 / nt) * (gsum / nt))
+        return y, aux
+
+    def apply_reference(self, params: Dict[str, Any], x, ep: int = 1):
+        """Single-device oracle: identical routing math, full expert stack.
+
+        ``ep`` emulates the expert-group sharding: tokens split into ``ep``
+        contiguous blocks (how ``P(("data", "expert"))`` lays a host array
+        out within one data group), each block claiming its OWN ``C``
+        capacity slots per expert — exactly the per-source-shard dispatch
+        the all_to_all layout gives the sharded path. Since capacity only
+        decides which (token, expert) pairs are kept, the oracle applies
+        experts per token and weighs by the combine weights — no slot
+        bookkeeping — and must equal :meth:`apply` bit-closely."""
+        n = x.shape[0]
+        if n % ep:
+            raise ValueError(f"{n} tokens not divisible by ep={ep}")
+        cap = self.capacity(n // ep)
+        ys, c1s, gsums = [], [], []
+        for blk in jnp.split(x, ep, axis=0):
+            gates = jax.nn.softmax(jnp.dot(blk, params["wg"]), axis=-1)
+            dispatch, combine, (c1, gsum, _) = _top_k_dispatch(
+                gates, cap, self.k
+            )
+            w = jnp.sum(combine, axis=-1)  # [Nb, E] kept combine weights
+            out_all = jax.vmap(
+                self._expert_ffn, in_axes=(0, 0, 0, 0, None)
+            )(params["w1"], params["b1"], params["w2"], params["b2"], blk)
+            ys.append(jnp.einsum("ne,end->nd", w, out_all))
+            c1s.append(c1)
+            gsums.append(gsum)
+        c1 = sum(c1s)
+        gsum = sum(gsums)
+        aux = self.n_experts * jnp.sum((c1 / n) * (gsum / n))
+        return jnp.concatenate(ys, axis=0), aux
+
+
+def build_ep_train_step(model: MoEFeedForward, mesh: Mesh, optimizer,
+                        per_sample_loss, aux_weight: float = 1e-2):
+    """Compile one dp×ep gradient-synchronous training step.
+
+    The objective is per-token regression/classification on the residual MoE
+    output ``y_pred = x + moe(x)``: global mean of ``per_sample_loss`` plus
+    ``aux_weight`` × (mean over data groups of the load-balancing aux).
+
+    Returns ``(step, opt_init)`` with the usual contract; ``x``/``y`` are
+    token blocks sharded over BOTH axes (``P(("data", "expert"))``), expert
+    stacks sharded over ``"expert"``, the router replicated.
+
+    Gradient collectives: expert stacks psum over ``"data"`` only — the
+    expert-axis contributions already arrived home through the
+    ``all_to_all`` transpose; the replicated router psums over both axes.
+    Both normalizations live INSIDE the differentiated scalar, so the psums
+    restore the exact global gradients (verified against the oracle).
+    """
+    if model.n_experts % mesh.shape[EXPERT_AXIS]:
+        raise ValueError(
+            f"n_experts {model.n_experts} not divisible by expert axis "
+            f"{mesh.shape[EXPERT_AXIS]}"
+        )
+    from .tensor import opt_state_specs
+
+    pspecs = model.specs()
+    sspecs = opt_state_specs(optimizer, model.param_shapes(), pspecs)
+    token_spec = P((DATA_AXIS, EXPERT_AXIS))
+    expert_keys = ("w1", "b1", "w2", "b2")
+    dp = mesh.shape[DATA_AXIS]
+    ep = mesh.shape[EXPERT_AXIS]
+
+    def step_impl(params, opt_state, x, y):
+        n_total = float(x.shape[0] * dp * ep)
+
+        def loss_fn(p):
+            h, aux = model.apply(p, x)
+            local = jnp.sum(per_sample_loss(y, x + h))
+            # Normalize inside the differentiated scalar: token mean + aux
+            # counted once per shard / (dp·ep) ⇒ psum of per-shard grads IS
+            # the global gradient (aux is identical across an expert group,
+            # so dividing by ep de-duplicates its ep copies).
+            return local / n_total + (aux_weight / (dp * ep)) * aux
+
+        objective, grads = jax.value_and_grad(loss_fn)(params)
+        grads = {
+            k: jax.lax.psum(
+                g if k in expert_keys else jax.lax.psum(g, EXPERT_AXIS),
+                DATA_AXIS,
+            )
+            for k, g in grads.items()
+        }
+        # Report the optimized objective itself (token mean + aux term):
+        # per-shard scalars are partials of the global sum by construction.
+        loss = jax.lax.psum(
+            jax.lax.psum(objective, EXPERT_AXIS), DATA_AXIS
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(jnp.add, params, updates)
+        return params, opt_state, loss
+
+    step = jax.jit(
+        jax.shard_map(
+            step_impl, mesh=mesh,
+            in_specs=(pspecs, sspecs, token_spec, token_spec),
+            out_specs=(pspecs, sspecs, P()),
+            check_vma=False,
+        ),
+        donate_argnums=(0, 1),
+    )
+    return step, make_opt_init(optimizer, mesh, sspecs)

@@ -34,6 +34,29 @@ def build_mesh(num_devices: Optional[int] = None,
     return Mesh(np.array(devs), (axis_name,))
 
 
+def build_mesh_2axis(second_axis: str, data: Optional[int] = None,
+                     second: int = 1,
+                     devices: Optional[Sequence] = None) -> Mesh:
+    """A 2-D ``("data", <second_axis>)`` mesh — the shared builder behind
+    ``build_mesh2d`` (tp), ``build_mesh_pp`` (pp), and ``build_mesh_ep``
+    (ep). ``data`` defaults to ``len(devices) // second``; adjacent devices
+    land on the same second-axis group (innermost), which on a real pod
+    keeps that axis's collectives on nearest-neighbor ICI links.
+    """
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if second < 1:
+        raise ValueError(f"{second_axis} axis size must be >= 1, got {second}")
+    if data is None:
+        data = len(devs) // second
+    need = data * second
+    if need > len(devs) or need < 1:
+        raise ValueError(
+            f"mesh {data}x{second} needs {need} devices, have {len(devs)}"
+        )
+    grid = np.array(devs[:need]).reshape(data, second)
+    return Mesh(grid, (DATA_AXIS, second_axis))
+
+
 def replicated_spec() -> PartitionSpec:
     return PartitionSpec()
 

@@ -1,0 +1,48 @@
+"""Shared parameter/optimizer plumbing for the sharded model classes.
+
+One home for the helpers the tensor/pipeline/expert modules would otherwise
+each re-implement: Glorot init, spec-driven mesh placement, host gather, and
+the spec-sharded ``optimizer.init`` builder.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def glorot(rng: np.random.Generator, *shape: int, dtype=np.float32) -> np.ndarray:
+    """Glorot-uniform over the trailing two dims (leading dims stack)."""
+    fan_in, fan_out = shape[-2], shape[-1]
+    limit = math.sqrt(6.0 / (fan_in + fan_out))
+    return rng.uniform(-limit, limit, size=shape).astype(dtype)
+
+
+def shard_by_specs(mesh: Mesh, specs: Dict[str, P],
+                   params: Dict[str, Any]) -> Dict[str, Any]:
+    """Place each named param on ``mesh`` with its PartitionSpec."""
+    return {
+        k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+        for k, v in params.items()
+    }
+
+
+def gather_host(params: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Device (possibly sharded) params → full host arrays."""
+    return {k: np.asarray(jax.device_get(v)) for k, v in params.items()}
+
+
+def make_opt_init(optimizer, mesh: Mesh, state_specs):
+    """``opt_init(params) -> opt_state`` jitted with the state sharded per
+    ``state_specs`` (a PartitionSpec tree shaped like the optax state)."""
+    return jax.jit(
+        optimizer.init,
+        out_shardings=jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), state_specs,
+            is_leaf=lambda s: isinstance(s, P),
+        ),
+    )

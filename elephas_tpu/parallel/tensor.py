@@ -37,41 +37,25 @@ the equivalence test verifies against a single-device dense oracle.
 
 from __future__ import annotations
 
-import math
 from functools import partial
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
-from .mesh import DATA_AXIS
+from .mesh import DATA_AXIS, build_mesh_2axis
+from .param_utils import gather_host, glorot, make_opt_init, shard_by_specs
 
 MODEL_AXIS = "model"
 
 
 def build_mesh2d(data: Optional[int] = None, model: int = 1,
                  devices: Optional[Sequence] = None) -> Mesh:
-    """A 2-D ``("data", "model")`` mesh.
-
-    ``model`` is the tensor-parallel degree; ``data`` defaults to
-    ``len(devices) // model``. Adjacent devices land on the same model group
-    (innermost axis), which on a real pod keeps the per-layer psum on
-    nearest-neighbor ICI links.
-    """
-    devs = list(devices) if devices is not None else list(jax.devices())
-    if model < 1:
-        raise ValueError(f"model axis size must be >= 1, got {model}")
-    if data is None:
-        data = len(devs) // model
-    need = data * model
-    if need > len(devs) or need < 1:
-        raise ValueError(
-            f"mesh {data}x{model} needs {need} devices, have {len(devs)}"
-        )
-    grid = np.array(devs[:need]).reshape(data, model)
-    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+    """A 2-D ``("data", "model")`` mesh; ``model`` = tensor-parallel degree."""
+    return build_mesh_2axis(MODEL_AXIS, data=data, second=model,
+                            devices=devices)
 
 
 # -- layer primitives (inside shard_map) --------------------------------------
@@ -211,11 +195,7 @@ class TensorParallelMLP:
         params: Dict[str, np.ndarray] = {}
         for name, sds in self.param_shapes().items():
             if len(sds.shape) == 2:
-                fan_in, fan_out = sds.shape
-                limit = math.sqrt(6.0 / (fan_in + fan_out))
-                params[name] = rng.uniform(
-                    -limit, limit, size=sds.shape
-                ).astype(sds.dtype)
+                params[name] = glorot(rng, *sds.shape, dtype=sds.dtype)
             else:
                 params[name] = np.zeros(sds.shape, sds.dtype)
         return params
@@ -234,15 +214,11 @@ class TensorParallelMLP:
         return specs
 
     def shard_params(self, mesh: Mesh, params: Dict[str, Any]) -> Dict[str, Any]:
-        specs = self.specs()
-        return {
-            k: jax.device_put(v, NamedSharding(mesh, specs[k]))
-            for k, v in params.items()
-        }
+        return shard_by_specs(mesh, self.specs(), params)
 
     def gather_params(self, params: Dict[str, Any]) -> Dict[str, np.ndarray]:
         """Device (possibly sharded) params → full host arrays."""
-        return {k: np.asarray(jax.device_get(v)) for k, v in params.items()}
+        return gather_host(params)
 
     def _layer_activation(self, i: int):
         """Hidden layers get ``activation`` (elementwise, so it applies to
@@ -351,11 +327,4 @@ def build_tp_train_step(model: TensorParallelMLP, mesh: Mesh, optimizer,
         donate_argnums=(0, 1),
     )
 
-    opt_init = jax.jit(
-        optimizer.init,
-        out_shardings=jax.tree_util.tree_map(
-            lambda s: NamedSharding(mesh, s), sspecs,
-            is_leaf=lambda s: isinstance(s, P),
-        ),
-    )
-    return step, opt_init
+    return step, make_opt_init(optimizer, mesh, sspecs)
