@@ -6,6 +6,7 @@ from .expert import (
     build_ep_train_step,
     build_mesh_ep,
 )
+from .fsdp import FSDPParams, build_fsdp_train_step
 from .mesh import DATA_AXIS, build_mesh
 from .pipeline import (
     PIPE_AXIS,
@@ -34,6 +35,8 @@ __all__ = [
     "build_tp_train_step",
     "column_parallel_dense",
     "row_parallel_dense",
+    "FSDPParams",
+    "build_fsdp_train_step",
     "EXPERT_AXIS",
     "build_mesh_ep",
     "MoEFeedForward",
