@@ -1,10 +1,24 @@
 from .adapters import KerasModelAdapter
 from .losses import resolve_accuracy, resolve_per_sample_loss
 from .optimizers import to_optax
+from .transformer import (
+    SEQ_AXIS,
+    TransformerLM,
+    build_lm_train_step,
+    build_mesh_sp,
+    make_lm_batches,
+    shard_lm_batch,
+)
 
 __all__ = [
     "KerasModelAdapter",
     "resolve_per_sample_loss",
     "resolve_accuracy",
     "to_optax",
+    "SEQ_AXIS",
+    "TransformerLM",
+    "build_mesh_sp",
+    "build_lm_train_step",
+    "make_lm_batches",
+    "shard_lm_batch",
 ]

@@ -1,0 +1,265 @@
+"""A functional causal-transformer LM with sequence-parallel training.
+
+EXTENSION BEYOND THE REFERENCE. The reference's largest sequence model is a
+whole-sequence-per-worker IMDB LSTM (SURVEY.md §5.7: long-context support
+"entirely absent"); this module is the model family that makes the
+framework's long-context machinery (``ops/ring_attention.py``,
+``ops/ulysses.py``) usable end-to-end: a GPT-style decoder-only LM whose
+training step shards the BATCH over the ``"data"`` mesh axis and the
+SEQUENCE over a ``"seq"`` axis in ONE ``shard_map`` program — maximum
+context length scales linearly with the seq-axis size, attention stays
+exact, and the whole dp×sp step is a single XLA executable.
+
+Design notes (TPU-first):
+
+- The model is a pure function over a flat dict of named arrays (layer
+  stacks carry a leading ``[L, ...]`` axis) — no framework objects cross the
+  jit boundary, and the same ``apply`` serves the sharded step and the
+  single-device oracle (``seq_axis=None``).
+- Attention is pluggable per call: dense reference (oracle), ring
+  (``ppermute`` KV rotation — few-head friendly, P nearest-neighbor hops),
+  or Ulysses (two ``all_to_all``s — needs ``H % P == 0``). Positions are
+  absolute (derived from the shard's seq-axis rank), so causal masking is
+  exact across shard boundaries.
+- Targets are supplied pre-shifted by the host (``make_lm_batches``), so no
+  cross-shard halo exchange is needed for the next-token objective.
+- Params/optimizer state replicate over both axes; gradients ride one
+  two-axis ``psum``. (Compose with ``parallel/fsdp.py`` to shard state —
+  the apply function is already the form ``build_fsdp_train_step`` takes.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.ring_attention import attention_reference, ring_attention_local
+from ..ops.ulysses import ulysses_attention_local
+from ..parallel.mesh import DATA_AXIS, build_mesh_2axis
+from ..parallel.param_utils import glorot, make_opt_init, shard_by_specs
+
+SEQ_AXIS = "seq"
+
+
+def build_mesh_sp(data: Optional[int] = None, seq: int = 1, devices=None) -> Mesh:
+    """A 2-D ``("data", "seq")`` mesh; ``seq`` = sequence-parallel degree."""
+    return build_mesh_2axis(SEQ_AXIS, data=data, second=seq, devices=devices)
+
+
+def _layer_norm(x, scale, bias, eps: float = 1e-5):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * scale + bias
+
+
+class TransformerLM:
+    """Decoder-only LM: embed → L pre-norm blocks (attn + FFN) → norm → head.
+
+    ``apply(params, tokens, positions, attn)`` is pure; ``attn`` is one of
+    ``"dense"`` (full attention, the oracle path), ``"ring"``, or
+    ``"ulysses"`` — the latter two call the INSIDE-shard_map bodies over
+    ``seq_axis`` and are only valid under ``shard_map``.
+    """
+
+    def __init__(self, vocab: int, d_model: int, n_heads: int, n_layers: int,
+                 d_ff: int, max_len: int):
+        if d_model % n_heads:
+            raise ValueError(f"d_model {d_model} not divisible by {n_heads} heads")
+        self.vocab = vocab
+        self.d_model = d_model
+        self.n_heads = n_heads
+        self.n_layers = n_layers
+        self.d_ff = d_ff
+        self.max_len = max_len
+
+    def param_shapes(self) -> Dict[str, jax.ShapeDtypeStruct]:
+        V, D, L, F, T = (self.vocab, self.d_model, self.n_layers, self.d_ff,
+                         self.max_len)
+        f32 = jnp.float32
+        sds = jax.ShapeDtypeStruct
+        return {
+            "tok": sds((V, D), f32),
+            "pos": sds((T, D), f32),
+            "ln1_s": sds((L, D), f32), "ln1_b": sds((L, D), f32),
+            "wq": sds((L, D, D), f32), "wk": sds((L, D, D), f32),
+            "wv": sds((L, D, D), f32), "wo": sds((L, D, D), f32),
+            "ln2_s": sds((L, D), f32), "ln2_b": sds((L, D), f32),
+            "w1": sds((L, D, F), f32), "b1": sds((L, F), f32),
+            "w2": sds((L, F, D), f32), "b2": sds((L, D), f32),
+            "lnf_s": sds((D,), f32), "lnf_b": sds((D,), f32),
+            "head": sds((D, V), f32),
+        }
+
+    def init(self, seed: int = 0) -> Dict[str, np.ndarray]:
+        rng = np.random.default_rng(seed)
+        out: Dict[str, np.ndarray] = {}
+        for name, sds in self.param_shapes().items():
+            if name.startswith(("ln1_s", "ln2_s", "lnf_s")):
+                out[name] = np.ones(sds.shape, np.float32)
+            elif name.startswith(("ln", "b")):
+                out[name] = np.zeros(sds.shape, np.float32)
+            elif name in ("tok", "pos"):
+                out[name] = (rng.normal(size=sds.shape) * 0.02).astype(np.float32)
+            else:
+                out[name] = glorot(rng, *sds.shape)
+        return out
+
+    def specs(self) -> Dict[str, P]:
+        """Replicated over both mesh axes (shard state via fsdp if needed)."""
+        return {k: P() for k in self.param_shapes()}
+
+    def shard_params(self, mesh: Mesh, params: Dict[str, Any]) -> Dict[str, Any]:
+        return shard_by_specs(mesh, self.specs(), params)
+
+    # ------------------------------------------------------------------
+    def _attend(self, q, k, v, attn: str, seq_axis: str):
+        if attn == "dense":
+            return attention_reference(q, k, v, causal=True)
+        if attn == "ring":
+            return ring_attention_local(q, k, v, causal=True,
+                                        axis_name=seq_axis)
+        if attn == "ulysses":
+            return ulysses_attention_local(q, k, v, causal=True,
+                                           axis_name=seq_axis)
+        raise ValueError(f"Unknown attn: {attn}")
+
+    def apply(self, params: Dict[str, Any], tokens, positions,
+              attn: str = "dense", seq_axis: str = SEQ_AXIS):
+        """``tokens``/``positions``: int ``[B, T_local]`` → logits
+        ``[B, T_local, V]``. ``positions`` are ABSOLUTE sequence positions
+        (the host computes them per shard), so causal masking and positional
+        embeddings are correct under sequence sharding."""
+        B, T = tokens.shape
+        H = self.n_heads
+        Dh = self.d_model // H
+        h = params["tok"][tokens] + params["pos"][positions]
+
+        block_keys = ("ln1_s", "ln1_b", "wq", "wk", "wv", "wo",
+                      "ln2_s", "ln2_b", "w1", "b1", "w2", "b2")
+
+        def block(h, lp):
+            # One compiled block scanned over the stacked [L, ...] axis —
+            # trace/compile cost stays constant in depth.
+            x = _layer_norm(h, lp["ln1_s"], lp["ln1_b"])
+            q = (x @ lp["wq"]).reshape(B, T, H, Dh)
+            k = (x @ lp["wk"]).reshape(B, T, H, Dh)
+            v = (x @ lp["wv"]).reshape(B, T, H, Dh)
+            a = self._attend(q, k, v, attn, seq_axis)
+            h = h + a.reshape(B, T, self.d_model) @ lp["wo"]
+            x = _layer_norm(h, lp["ln2_s"], lp["ln2_b"])
+            h = h + jax.nn.relu(x @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"]
+            return h, None
+
+        h, _ = jax.lax.scan(block, h, {k: params[k] for k in block_keys})
+        h = _layer_norm(h, params["lnf_s"], params["lnf_b"])
+        return h @ params["head"]
+
+    def loss(self, params, tokens, positions, targets, attn="dense",
+             seq_axis: str = SEQ_AXIS):
+        """Summed next-token cross-entropy over the local shard."""
+        logits = self.apply(params, tokens, positions, attn, seq_axis)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return -jnp.sum(ll)
+
+
+def make_lm_batches(token_rows: np.ndarray):
+    """Host-side prep: ``[B, T+1]`` int rows → ``(tokens, positions,
+    targets)`` each ``[B, T]``, targets pre-shifted so sequence sharding
+    needs no cross-shard halo."""
+    tokens = token_rows[:, :-1]
+    targets = token_rows[:, 1:]
+    positions = np.broadcast_to(
+        np.arange(tokens.shape[1], dtype=np.int32), tokens.shape
+    )
+    return tokens.astype(np.int32), positions.copy(), targets.astype(np.int32)
+
+
+def build_lm_train_step(model: TransformerLM, mesh: Mesh, optimizer,
+                        attn: str = "ring"):
+    """Compile one dp×sp LM training step.
+
+    Returns ``(step, opt_init)``: ``step(params, opt_state, tokens,
+    positions, targets) -> (params, opt_state, mean_loss)`` with all three
+    int arrays ``[B, T]`` — batch dim sharded over ``"data"``, sequence dim
+    over ``"seq"``; params/state replicated; one two-axis gradient psum.
+    """
+    sp = mesh.shape[SEQ_AXIS]
+    if attn not in ("dense", "ring", "ulysses"):
+        raise ValueError(f"Unknown attn: {attn}")
+    if attn == "ulysses" and model.n_heads % sp:
+        raise ValueError(
+            f"attn='ulysses' needs head count {model.n_heads} divisible by "
+            f"the seq axis size {sp} (use attn='ring' for few-head models)"
+        )
+    if model.max_len % sp:
+        raise ValueError(
+            f"max_len {model.max_len} not divisible by seq axis size {sp}"
+        )
+    pspecs = model.specs()
+    sspecs = jax.tree_util.tree_map(
+        lambda _: P(),
+        jax.eval_shape(optimizer.init, model.param_shapes()),
+    )
+    tok_spec = P(DATA_AXIS, SEQ_AXIS)
+
+    def step_impl(params, opt_state, tokens, positions, targets):
+        ntok_local = tokens.shape[0] * tokens.shape[1]
+
+        def loss_fn(p):
+            return model.loss(p, tokens, positions, targets, attn=attn)
+
+        local_loss, grads = jax.value_and_grad(loss_fn)(params)
+        ntok = jax.lax.psum(
+            jax.lax.psum(jnp.asarray(ntok_local, jnp.float32), SEQ_AXIS),
+            DATA_AXIS,
+        )
+        grads = jax.tree_util.tree_map(
+            lambda g: jax.lax.psum(
+                jax.lax.psum(g, SEQ_AXIS), DATA_AXIS
+            ) / ntok,
+            grads,
+        )
+        loss = jax.lax.psum(
+            jax.lax.psum(local_loss, SEQ_AXIS), DATA_AXIS
+        ) / ntok
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(jnp.add, params, updates)
+        return params, opt_state, loss
+
+    jit_step = jax.jit(
+        jax.shard_map(
+            step_impl, mesh=mesh,
+            in_specs=(pspecs, sspecs, tok_spec, tok_spec, tok_spec),
+            out_specs=(pspecs, sspecs, P()),
+            check_vma=False,
+        ),
+        donate_argnums=(0, 1),
+    )
+
+    def step(params, opt_state, tokens, positions, targets):
+        t = tokens.shape[1]
+        # JAX clamps out-of-range gathers under jit, so an over-long
+        # sequence would silently reuse the last positional-embedding row —
+        # reject it here where shapes are visible.
+        if t > model.max_len:
+            raise ValueError(
+                f"sequence length {t} exceeds max_len {model.max_len}"
+            )
+        if t % sp:
+            raise ValueError(
+                f"sequence length {t} not divisible by seq axis size {sp}"
+            )
+        return jit_step(params, opt_state, tokens, positions, targets)
+
+    return step, make_opt_init(optimizer, mesh, sspecs)
+
+
+def shard_lm_batch(mesh: Mesh, tokens, positions, targets):
+    """Place host ``[B, T]`` arrays on the dp×sp mesh."""
+    sharding = NamedSharding(mesh, P(DATA_AXIS, SEQ_AXIS))
+    return tuple(jax.device_put(a, sharding) for a in (tokens, positions, targets))

@@ -102,6 +102,12 @@ def _ring_attention_local(q, k, v, causal: bool, axis_name: str):
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B, Tq, H, D]
 
 
+# Public alias: the per-shard body for composing ring attention INSIDE a
+# larger shard_map program (e.g. the sequence-parallel transformer in
+# ``models/transformer.py``) rather than through the standalone
+# ``ring_attention`` wrapper below.
+ring_attention_local = _ring_attention_local
+
 _COMPILED = {}
 
 

@@ -46,6 +46,11 @@ def _ulysses_local(q, k, v, causal: bool, axis_name: str):
     )
 
 
+# Public alias: the per-shard body for composing Ulysses attention INSIDE a
+# larger shard_map program (see ``models/transformer.py``).
+ulysses_attention_local = _ulysses_local
+
+
 def ulysses_attention(q, k, v, mesh=None, causal: bool = False,
                       axis_name: str = DATA_AXIS):
     """Exact attention over sequences sharded across a mesh axis, via

@@ -38,7 +38,13 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .mesh import DATA_AXIS, build_mesh_2axis
-from .param_utils import gather_host, glorot, make_opt_init, shard_by_specs
+from .param_utils import (
+    gather_host,
+    glorot,
+    make_opt_init,
+    opt_state_specs,
+    shard_by_specs,
+)
 
 EXPERT_AXIS = "expert"
 
@@ -253,7 +259,6 @@ def build_ep_train_step(model: MoEFeedForward, mesh: Mesh, optimizer,
             f"n_experts {model.n_experts} not divisible by expert axis "
             f"{mesh.shape[EXPERT_AXIS]}"
         )
-    from .tensor import opt_state_specs
 
     pspecs = model.specs()
     sspecs = opt_state_specs(optimizer, model.param_shapes(), pspecs)

@@ -46,3 +46,29 @@ def make_opt_init(optimizer, mesh: Mesh, state_specs):
             is_leaf=lambda s: isinstance(s, P),
         ),
     )
+
+
+def opt_state_specs(optimizer, params: Dict[str, Any],
+                    specs: Dict[str, P]):
+    """PartitionSpec tree for ``optimizer.init(params)``'s state.
+
+    Optax state trees embed the params dict as subtrees (``mu``/``nu``/
+    momentum carry the same keys), so each state leaf inherits the spec of
+    the param whose dict key appears innermost on its tree path — provided
+    the shapes agree; scalar bookkeeping (step counts) replicates.
+    """
+    shaped_params = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype), params
+    )
+    shaped = jax.eval_shape(optimizer.init, shaped_params)
+    path_leaves, treedef = jax.tree_util.tree_flatten_with_path(shaped)
+    spec_leaves = []
+    for path, leaf in path_leaves:
+        spec = P()
+        for entry in reversed(path):
+            key = getattr(entry, "key", None)
+            if key in specs and tuple(leaf.shape) == tuple(params[key].shape):
+                spec = specs[key]
+                break
+        spec_leaves.append(spec)
+    return jax.tree_util.tree_unflatten(treedef, spec_leaves)

@@ -38,7 +38,13 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .mesh import DATA_AXIS, build_mesh_2axis
-from .param_utils import gather_host, glorot, make_opt_init, shard_by_specs
+from .param_utils import (
+    gather_host,
+    glorot,
+    make_opt_init,
+    opt_state_specs,
+    shard_by_specs,
+)
 
 PIPE_AXIS = "pipe"
 
@@ -216,8 +222,6 @@ def build_pp_train_step(model: PipelineDenseStack, mesh: Mesh, optimizer,
             f"{model.n_stages} (one stage per pipe rank)"
         )
     pspecs = model.specs()
-    from .tensor import opt_state_specs  # spec inheritance is layout-agnostic
-
     sspecs = opt_state_specs(optimizer, model.param_shapes(), pspecs)
     data_spec = P(DATA_AXIS)
     stage_keys = ("w", "b")

@@ -46,7 +46,13 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .mesh import DATA_AXIS, build_mesh_2axis
-from .param_utils import gather_host, glorot, make_opt_init, shard_by_specs
+from .param_utils import (  # noqa: F401 — opt_state_specs re-exported
+    gather_host,
+    glorot,
+    make_opt_init,
+    opt_state_specs,
+    shard_by_specs,
+)
 
 MODEL_AXIS = "model"
 
@@ -249,32 +255,6 @@ class TensorParallelMLP:
             if act is not None:
                 h = act(h)
         return h
-
-
-def opt_state_specs(optimizer, params: Dict[str, Any],
-                    specs: Dict[str, P]):
-    """PartitionSpec tree for ``optimizer.init(params)``'s state.
-
-    Optax state trees embed the params dict as subtrees (``mu``/``nu``/
-    momentum carry the same keys), so each state leaf inherits the spec of
-    the param whose dict key appears innermost on its tree path — provided
-    the shapes agree; scalar bookkeeping (step counts) replicates.
-    """
-    shaped_params = jax.tree_util.tree_map(
-        lambda a: jax.ShapeDtypeStruct(tuple(a.shape), a.dtype), params
-    )
-    shaped = jax.eval_shape(optimizer.init, shaped_params)
-    path_leaves, treedef = jax.tree_util.tree_flatten_with_path(shaped)
-    spec_leaves = []
-    for path, leaf in path_leaves:
-        spec = P()
-        for entry in reversed(path):
-            key = getattr(entry, "key", None)
-            if key in specs and tuple(leaf.shape) == tuple(params[key].shape):
-                spec = specs[key]
-                break
-        spec_leaves.append(spec)
-    return jax.tree_util.tree_unflatten(treedef, spec_leaves)
 
 
 def build_tp_train_step(model: TensorParallelMLP, mesh: Mesh, optimizer,
