@@ -1,0 +1,88 @@
+"""TPE sampler: concentration, determinism, and mixed-space handling."""
+
+import math
+import random
+
+import pytest
+
+from elephas_tpu.hyperparam import (
+    STATUS_OK,
+    TPESampler,
+    _Choice,
+    _LogUniform,
+    _QUniform,
+    _Uniform,
+)
+
+
+def _trials(spaces, losses_for, n, seed=0):
+    rng = random.Random(seed)
+    out = []
+    for _ in range(n):
+        params = [s.sample(rng) for s in spaces]
+        out.append({"loss": losses_for(params), "status": STATUS_OK,
+                    "params": params})
+    return out
+
+
+def test_startup_is_random_prior():
+    spaces = [_Uniform(0, 1)]
+    sampler = TPESampler(spaces, n_startup=5)
+    rng = random.Random(0)
+    got = sampler.suggest([], rng)
+    assert 0.0 <= got[0] <= 1.0
+
+
+def test_concentrates_on_good_region():
+    """With a quadratic loss around x=2, proposals must shift toward 2."""
+    spaces = [_Uniform(0.0, 10.0)]
+    trials = _trials(spaces, lambda p: (p[0] - 2.0) ** 2, n=40)
+    sampler = TPESampler(spaces)
+    rng = random.Random(1)
+    proposals = [sampler.suggest(trials, rng)[0] for _ in range(50)]
+    mean = sum(proposals) / len(proposals)
+    # prior mean is 5.0; the TPE posterior must sit far closer to 2.0
+    assert abs(mean - 2.0) < 1.5, mean
+    assert all(0.0 <= p <= 10.0 for p in proposals)
+
+
+def test_loguniform_concentrates_in_log_space():
+    spaces = [_LogUniform(1e-5, 1.0)]
+    # best losses near 1e-3
+    trials = _trials(
+        spaces, lambda p: abs(math.log10(p[0]) - (-3.0)), n=40
+    )
+    sampler = TPESampler(spaces)
+    rng = random.Random(2)
+    proposals = [sampler.suggest(trials, rng)[0] for _ in range(50)]
+    logs = [math.log10(p) for p in proposals]
+    mean = sum(logs) / len(logs)
+    assert abs(mean - (-3.0)) < 1.2, mean
+
+
+def test_choice_prefers_winning_option():
+    spaces = [_Choice([16, 32, 64, 128])]
+    trials = _trials(
+        spaces, lambda p: 0.0 if p[0] == 64 else 1.0, n=40
+    )
+    sampler = TPESampler(spaces)
+    rng = random.Random(3)
+    proposals = [sampler.suggest(trials, rng)[0] for _ in range(60)]
+    frac = sum(1 for p in proposals if p == 64) / len(proposals)
+    assert frac > 0.5, frac
+
+
+def test_mixed_space_and_determinism():
+    spaces = [_Uniform(0, 1), _Choice(["a", "b"]), _QUniform(0, 100, 10),
+              _LogUniform(1e-4, 1e-1)]
+    trials = _trials(
+        spaces,
+        lambda p: p[0] + (0.0 if p[1] == "b" else 1.0) + abs(p[2] - 50) / 100,
+        n=30,
+    )
+    sampler = TPESampler(spaces)
+    a = sampler.suggest(trials, random.Random(7))
+    b = sampler.suggest(trials, random.Random(7))
+    assert a == b  # same rng state → same proposal
+    assert a[1] in ("a", "b")
+    assert a[2] % 10 == 0
