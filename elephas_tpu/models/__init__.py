@@ -3,6 +3,7 @@ from .losses import resolve_accuracy, resolve_per_sample_loss
 from .optimizers import to_optax
 from .transformer import (
     SEQ_AXIS,
+    MoETransformerLM,
     TransformerLM,
     build_lm_train_step,
     build_mesh_sp,
@@ -17,6 +18,7 @@ __all__ = [
     "to_optax",
     "SEQ_AXIS",
     "TransformerLM",
+    "MoETransformerLM",
     "build_mesh_sp",
     "build_lm_train_step",
     "make_lm_batches",

@@ -75,6 +75,7 @@ class TransformerLM:
         self.n_layers = n_layers
         self.d_ff = d_ff
         self.max_len = max_len
+        self.aux_weight = 0.0  # MoE variant sets a nonzero weight
 
     def param_shapes(self) -> Dict[str, jax.ShapeDtypeStruct]:
         V, D, L, F, T = (self.vocab, self.d_model, self.n_layers, self.d_ff,
@@ -133,13 +134,17 @@ class TransformerLM:
         ``[B, T_local, V]``. ``positions`` are ABSOLUTE sequence positions
         (the host computes them per shard), so causal masking and positional
         embeddings are correct under sequence sharding."""
+        return self.apply_with_aux(params, tokens, positions, attn, seq_axis)[0]
+
+    def apply_with_aux(self, params: Dict[str, Any], tokens, positions,
+                       attn: str = "dense", seq_axis: str = SEQ_AXIS):
+        """Like :meth:`apply` but also returns the summed auxiliary loss
+        (0.0 for the dense-FFN base model; the MoE variant's load-balancing
+        term)."""
         B, T = tokens.shape
         H = self.n_heads
         Dh = self.d_model // H
         h = params["tok"][tokens] + params["pos"][positions]
-
-        block_keys = ("ln1_s", "ln1_b", "wq", "wk", "wv", "wo",
-                      "ln2_s", "ln2_b", "w1", "b1", "w2", "b2")
 
         def block(h, lp):
             # One compiled block scanned over the stacked [L, ...] axis —
@@ -151,12 +156,25 @@ class TransformerLM:
             a = self._attend(q, k, v, attn, seq_axis)
             h = h + a.reshape(B, T, self.d_model) @ lp["wo"]
             x = _layer_norm(h, lp["ln2_s"], lp["ln2_b"])
-            h = h + jax.nn.relu(x @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"]
-            return h, None
+            out, aux = self._ffn(lp, x, attn, seq_axis)
+            return h + out, aux
 
-        h, _ = jax.lax.scan(block, h, {k: params[k] for k in block_keys})
+        h, auxes = jax.lax.scan(
+            block, h, {k: params[k] for k in self._block_keys()}
+        )
         h = _layer_norm(h, params["lnf_s"], params["lnf_b"])
-        return h @ params["head"]
+        return h @ params["head"], jnp.sum(auxes)
+
+    def _block_keys(self):
+        return ("ln1_s", "ln1_b", "wq", "wk", "wv", "wo",
+                "ln2_s", "ln2_b", "w1", "b1", "w2", "b2")
+
+    def _ffn(self, lp, x, attn: str, seq_axis: str):
+        """Per-block FFN hook → ``(residual_delta, aux_loss)``. The MoE
+        variant overrides this with routed experts."""
+        del attn, seq_axis
+        out = jax.nn.relu(x @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"]
+        return out, jnp.asarray(0.0, x.dtype)
 
     def loss(self, params, tokens, positions, targets, attn="dense",
              seq_axis: str = SEQ_AXIS):
@@ -165,6 +183,76 @@ class TransformerLM:
         logp = jax.nn.log_softmax(logits, axis=-1)
         ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
         return -jnp.sum(ll)
+
+
+class MoETransformerLM(TransformerLM):
+    """Mixture-of-experts transformer: every block's FFN is a top-k routed
+    expert layer, experts sharded over the SAME ``"seq"`` mesh axis the
+    sequence rides (the standard overlap of sp and ep groups — no third
+    axis needed, and the MoE all_to_alls stay inside the sequence group).
+    One ``shard_map`` program therefore combines dp×sp×ep.
+
+    ``ep_groups`` only matters on the dense (oracle) path: it emulates the
+    per-source-shard dispatch groups of a ``seq``-axis size it should match
+    (the sharded path gets the group size from the axis itself). Total
+    parameters scale with ``n_experts`` while per-token FLOPs stay constant;
+    the Switch load-balancing aux (weighted ``aux_weight``) enters the
+    training objective.
+    """
+
+    def __init__(self, vocab: int, d_model: int, n_heads: int, n_layers: int,
+                 d_ff: int, max_len: int, n_experts: int, k: int = 2,
+                 capacity_factor: float = 1.25, aux_weight: float = 1e-2,
+                 ep_groups: int = 1):
+        super().__init__(vocab, d_model, n_heads, n_layers, d_ff, max_len)
+        from ..parallel.expert import MoEFeedForward
+
+        self.moe = MoEFeedForward(d_model, d_ff, n_experts, k=k,
+                                  capacity_factor=capacity_factor)
+        self.n_experts = n_experts
+        self.aux_weight = aux_weight
+        self.ep_groups = int(ep_groups)
+
+    def param_shapes(self) -> Dict[str, jax.ShapeDtypeStruct]:
+        shapes = super().param_shapes()
+        L = self.n_layers
+        # replace the dense FFN stacks with per-layer expert stacks
+        for k_ in ("w1", "b1", "w2", "b2"):
+            del shapes[k_]
+        for k_, sds in self.moe.param_shapes().items():
+            shapes[k_] = jax.ShapeDtypeStruct((L,) + sds.shape, sds.dtype)
+        return shapes
+
+    def specs(self) -> Dict[str, P]:
+        specs = {k: P() for k in self.param_shapes()}
+        for k_ in ("w1", "b1", "w2", "b2"):
+            specs[k_] = P(None, SEQ_AXIS)  # [L, E, ...]: E over "seq"
+        return specs
+
+    def _block_keys(self):
+        return ("ln1_s", "ln1_b", "wq", "wk", "wv", "wo",
+                "ln2_s", "ln2_b", "wg", "w1", "b1", "w2", "b2")
+
+    def _ffn(self, lp, x, attn: str, seq_axis: str):
+        B, T = x.shape[0], x.shape[1]
+        moe_params = {k_: lp[k_] for k_ in ("wg", "w1", "b1", "w2", "b2")}
+        if attn != "dense":
+            flat = x.reshape(B * T, self.d_model)
+            y, aux = self.moe.apply(moe_params, flat, axis_name=seq_axis)
+            return y.reshape(B, T, self.d_model), aux
+        # dense oracle path: each seq-axis dispatch group is one sequence
+        # chunk flattened batch-major (exactly how a shard flattens its
+        # local block) — re-layout so MoEFeedForward.apply_reference's
+        # contiguous per-group emulation sees the same token groups.
+        G = self.ep_groups
+        if T % G:
+            raise ValueError(f"T={T} not divisible by ep_groups={G}")
+        tl = T // G
+        D = self.d_model
+        xg = x.reshape(B, G, tl, D).transpose(1, 0, 2, 3).reshape(G * B * tl, D)
+        y, aux = self.moe.apply_reference(moe_params, xg, ep=G)
+        y = y.reshape(G, B, tl, D).transpose(1, 0, 2, 3).reshape(B, T, D)
+        return y, aux
 
 
 def make_lm_batches(token_rows: np.ndarray):
@@ -181,12 +269,18 @@ def make_lm_batches(token_rows: np.ndarray):
 
 def build_lm_train_step(model: TransformerLM, mesh: Mesh, optimizer,
                         attn: str = "ring"):
-    """Compile one dp×sp LM training step.
+    """Compile one dp×sp (×ep for the MoE variant) LM training step.
 
     Returns ``(step, opt_init)``: ``step(params, opt_state, tokens,
-    positions, targets) -> (params, opt_state, mean_loss)`` with all three
-    int arrays ``[B, T]`` — batch dim sharded over ``"data"``, sequence dim
-    over ``"seq"``; params/state replicated; one two-axis gradient psum.
+    positions, targets) -> (params, opt_state, loss)`` with all three int
+    arrays ``[B, T]`` — batch dim sharded over ``"data"``, sequence dim over
+    ``"seq"``. Params and optimizer state follow ``model.specs()``: fully
+    replicated for the dense model; for :class:`MoETransformerLM` the expert
+    stacks (and their optimizer state) shard over ``"seq"`` and their
+    gradients skip the seq-axis psum (each seq rank owns its experts — the
+    all_to_all transpose already delivered their gradients locally).
+    ``loss`` is the optimized objective: token-mean CE plus the
+    ``aux_weight``-scaled load-balancing term (zero for the dense model).
     """
     sp = mesh.shape[SEQ_AXIS]
     if attn not in ("dense", "ring", "ulysses"):
@@ -200,33 +294,65 @@ def build_lm_train_step(model: TransformerLM, mesh: Mesh, optimizer,
         raise ValueError(
             f"max_len {model.max_len} not divisible by seq axis size {sp}"
         )
+    if attn == "dense" and sp > 1:
+        raise ValueError(
+            "attn='dense' is the single-device oracle path: under a seq "
+            f"axis of size {sp} it would attend within each sequence chunk "
+            "only (silently wrong) — use attn='ring' or 'ulysses'"
+        )
+    moe = getattr(model, "moe", None)
+    if moe is not None and moe.n_experts % sp:
+        raise ValueError(
+            f"n_experts {moe.n_experts} not divisible by seq axis size {sp} "
+            "(experts shard over the sequence axis)"
+        )
+    from ..parallel.param_utils import opt_state_specs
+
     pspecs = model.specs()
-    sspecs = jax.tree_util.tree_map(
-        lambda _: P(),
-        jax.eval_shape(optimizer.init, model.param_shapes()),
-    )
+    sspecs = opt_state_specs(optimizer, model.param_shapes(), pspecs)
     tok_spec = P(DATA_AXIS, SEQ_AXIS)
+    # Params whose spec mentions the seq axis (MoE expert stacks) are OWNED
+    # per seq rank: their gradients arrive locally through the all_to_all
+    # transpose and must NOT be summed over "seq".
+    def _mentions_seq(spec):
+        for ax in spec:
+            axes = ax if isinstance(ax, tuple) else (ax,)
+            if SEQ_AXIS in axes:
+                return True
+        return False
+
+    seq_sharded = {k for k, s in pspecs.items() if _mentions_seq(s)}
+
+    dp = mesh.shape[DATA_AXIS]
 
     def step_impl(params, opt_state, tokens, positions, targets):
-        ntok_local = tokens.shape[0] * tokens.shape[1]
+        # token count is static, so normalization can live INSIDE the
+        # differentiated scalar: psum of per-shard objectives IS the global
+        # objective (the aux term is identical across a data group's seq
+        # ranks, so /(dp·sp) de-duplicates its sp copies).
+        ntok_total = float(tokens.shape[0] * tokens.shape[1] * dp * sp)
 
         def loss_fn(p):
-            return model.loss(p, tokens, positions, targets, attn=attn)
+            logits, aux = model.apply_with_aux(
+                p, tokens, positions, attn=attn
+            )
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+            return -jnp.sum(ll) / ntok_total + (
+                model.aux_weight / (dp * sp)
+            ) * aux
 
-        local_loss, grads = jax.value_and_grad(loss_fn)(params)
-        ntok = jax.lax.psum(
-            jax.lax.psum(jnp.asarray(ntok_local, jnp.float32), SEQ_AXIS),
-            DATA_AXIS,
-        )
-        grads = jax.tree_util.tree_map(
-            lambda g: jax.lax.psum(
-                jax.lax.psum(g, SEQ_AXIS), DATA_AXIS
-            ) / ntok,
-            grads,
-        )
+        objective, grads = jax.value_and_grad(loss_fn)(params)
+        grads = {
+            k: jax.lax.psum(
+                g if k in seq_sharded else jax.lax.psum(g, SEQ_AXIS),
+                DATA_AXIS,
+            )
+            for k, g in grads.items()
+        }
         loss = jax.lax.psum(
-            jax.lax.psum(local_loss, SEQ_AXIS), DATA_AXIS
-        ) / ntok
+            jax.lax.psum(objective, SEQ_AXIS), DATA_AXIS
+        )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = jax.tree_util.tree_map(jnp.add, params, updates)
         return params, opt_state, loss
