@@ -13,6 +13,13 @@ from .rdd_utils import (
     to_labeled_point,
     to_simple_rdd,
 )
+from .checkpoint import (
+    load_checkpoint,
+    load_pytree,
+    place_like,
+    save_checkpoint,
+    save_pytree,
+)
 from .serialization import dict_to_model, model_to_dict
 from .sockets import determine_master, receive, receive_all, send
 
@@ -30,6 +37,11 @@ __all__ = [
     "encode_label",
     "model_to_dict",
     "dict_to_model",
+    "save_checkpoint",
+    "load_checkpoint",
+    "save_pytree",
+    "load_pytree",
+    "place_like",
     "determine_master",
     "send",
     "receive",

@@ -23,22 +23,99 @@ import numpy as np
 from .serialization import load_weights_npz, save_weights_npz
 
 
+def _leaf_to_host(leaf) -> np.ndarray:
+    """Device (possibly globally-sharded) leaf → full host array.
+
+    Multi-process arrays span non-addressable devices, which plain
+    ``device_get`` refuses; gather them through the multihost helper.
+    """
+    import jax
+
+    if jax.process_count() > 1 and hasattr(leaf, "sharding"):
+        from jax.experimental import multihost_utils
+
+        leaf = multihost_utils.process_allgather(leaf, tiled=True)
+    arr = np.asarray(jax.device_get(leaf))
+    if arr.dtype.kind not in "biufc":  # bool/int/uint/float/complex only
+        raise TypeError(
+            "checkpoint trees must hold numeric array leaves; got a "
+            f"non-numeric leaf of type {type(leaf).__name__} (dtype "
+            f"{arr.dtype}) — object dtypes round-trip through npz only "
+            "with pickle, which load refuses"
+        )
+    return arr
+
+
+def _save_tree(directory: str, tree: Any, leaves_name: str,
+               treedef_name: str) -> None:
+    """Shared flatten-to-npz + pickled-treedef writer (single format for
+    both checkpoint kinds). Only process 0 writes in multi-process runs."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    host = {f"l{i}": _leaf_to_host(leaf) for i, leaf in enumerate(leaves)}
+    if jax.process_index() != 0:
+        return
+    np.savez(os.path.join(directory, leaves_name), **host)
+    with open(os.path.join(directory, treedef_name), "wb") as f:
+        pickle.dump(treedef, f)
+
+
+def _load_tree(directory: str, leaves_name: str, treedef_name: str) -> Any:
+    import jax
+
+    with np.load(os.path.join(directory, leaves_name)) as data:
+        leaves = [data[f"l{i}"] for i in range(len(data.files))]
+    with open(os.path.join(directory, treedef_name), "rb") as f:
+        treedef = pickle.load(f)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
 def save_checkpoint(directory: str, weights: List[np.ndarray],
                     meta: Dict[str, Any], opt_state: Any = None) -> None:
     os.makedirs(directory, exist_ok=True)
     save_weights_npz(os.path.join(directory, "weights.npz"), weights)
     if opt_state is not None:
-        import jax
-
-        leaves, treedef = jax.tree_util.tree_flatten(opt_state)
-        np.savez(
-            os.path.join(directory, "opt_state.npz"),
-            **{f"l{i}": np.asarray(leaf) for i, leaf in enumerate(leaves)},
-        )
-        with open(os.path.join(directory, "opt_treedef.pkl"), "wb") as f:
-            pickle.dump(treedef, f)
+        _save_tree(directory, opt_state, "opt_state.npz", "opt_treedef.pkl")
     with open(os.path.join(directory, "meta.json"), "w") as f:
         json.dump(meta, f)
+
+
+def save_pytree(path: str, tree: Any) -> None:
+    """Checkpoint a pytree of numeric arrays (param dicts, optax states —
+    sharded/chunked device arrays included; multi-process global arrays are
+    gathered via the multihost helper and written by process 0).
+
+    The generic form of :func:`save_checkpoint` for the parallelism
+    extension trainers (tp/pp/ep/fsdp/LM), whose state is a pytree rather
+    than an ordered Keras weight list. ``path`` names a directory holding
+    ``leaves.npz`` + ``treedef.pkl``. Non-numeric leaves are rejected at
+    save time (they would only fail at resume).
+    """
+    os.makedirs(path, exist_ok=True)
+    _save_tree(path, tree, "leaves.npz", "treedef.pkl")
+
+
+def load_pytree(path: str) -> Any:
+    """Load a :func:`save_pytree` checkpoint as host (numpy) leaves."""
+    return _load_tree(path, "leaves.npz", "treedef.pkl")
+
+
+def place_like(template: Any, host_tree: Any) -> Any:
+    """Put each host leaf on device with the matching ``template`` leaf's
+    sharding — the resume half of :func:`save_pytree`.
+
+    ``template`` is a freshly built same-shape tree (e.g. ``opt_init(params)``
+    or ``model.shard_params(mesh, model.init())``) whose leaves carry the
+    target ``NamedSharding``s; its values are discarded.
+    """
+    import jax
+
+    def put(t, h):
+        sharding = getattr(t, "sharding", None)
+        return jax.device_put(h, sharding) if sharding is not None else h
+
+    return jax.tree_util.tree_map(put, template, host_tree)
 
 
 def load_checkpoint(directory: str) -> Tuple[List[np.ndarray], Dict[str, Any], Any]:
@@ -47,15 +124,8 @@ def load_checkpoint(directory: str) -> Tuple[List[np.ndarray], Dict[str, Any], A
     with open(os.path.join(directory, "meta.json")) as f:
         meta = json.load(f)
     opt_state = None
-    opt_path = os.path.join(directory, "opt_state.npz")
-    if os.path.exists(opt_path):
-        import jax
-
-        with np.load(opt_path) as data:
-            leaves = [data[f"l{i}"] for i in range(len(data.files))]
-        with open(os.path.join(directory, "opt_treedef.pkl"), "rb") as f:
-            treedef = pickle.load(f)
-        opt_state = jax.tree_util.tree_unflatten(treedef, leaves)
+    if os.path.exists(os.path.join(directory, "opt_state.npz")):
+        opt_state = _load_tree(directory, "opt_state.npz", "opt_treedef.pkl")
     return weights, meta, opt_state
 
 

@@ -1,0 +1,202 @@
+"""Checkpoint/resume for the parallelism-extension trainers.
+
+A run interrupted after k steps and resumed from a pytree checkpoint must
+continue bit-identically to an uninterrupted run — including sharded (tp)
+and chunked (fsdp) parameter layouts and their optimizer states.
+"""
+
+import numpy as np
+import optax
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from elephas_tpu.parallel import build_mesh
+from elephas_tpu.parallel.fsdp import build_fsdp_train_step
+from elephas_tpu.parallel.tensor import (
+    TensorParallelMLP,
+    build_mesh2d,
+    build_tp_train_step,
+)
+from elephas_tpu.utils.checkpoint import load_pytree, place_like, save_pytree
+
+
+def _softmax_xent(y, y_pred):
+    logp = jax.nn.log_softmax(y_pred, axis=-1)
+    return -jnp.sum(y * logp, axis=-1)
+
+
+def _task(seed=3, n=32, d=10, c=4):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, d)).astype(np.float32)
+    y = np.eye(c, dtype=np.float32)[rng.integers(0, c, size=n)]
+    return x, y
+
+
+def test_fsdp_resume_is_bit_identical(tmp_path):
+    mesh = build_mesh(8)
+    shapes = {"w0": (10, 17), "b0": (17,), "w1": (17, 4), "b1": (4,)}
+
+    def apply_fn(p, xb):
+        h = jax.nn.relu(jnp.dot(xb, p["w0"]) + p["b0"])
+        return jnp.dot(h, p["w1"]) + p["b1"]
+
+    step, opt_init, fsdp = build_fsdp_train_step(
+        apply_fn, shapes, mesh, optax.adam(1e-2), _softmax_xent
+    )
+    rng = np.random.default_rng(0)
+    host = {k: (rng.normal(size=s) * 0.1).astype(np.float32)
+            for k, s in shapes.items()}
+    x, y = _task()
+    xd = jax.device_put(x, NamedSharding(mesh, P("data")))
+    yd = jax.device_put(y, NamedSharding(mesh, P("data")))
+
+    # uninterrupted run: 4 steps
+    chunks = fsdp.shard(mesh, fsdp.chunk_host(host))
+    state = opt_init(chunks)
+    for _ in range(4):
+        chunks, state, _ = step(chunks, state, xd, yd)
+    want = fsdp.unchunk_host({k: np.asarray(v) for k, v in chunks.items()})
+
+    # interrupted run: 2 steps, checkpoint, reload, 2 more
+    chunks = fsdp.shard(mesh, fsdp.chunk_host(host))
+    state = opt_init(chunks)
+    for _ in range(2):
+        chunks, state, _ = step(chunks, state, xd, yd)
+    save_pytree(str(tmp_path / "params"), chunks)
+    save_pytree(str(tmp_path / "opt"), state)
+
+    fresh_chunks = fsdp.shard(mesh, fsdp.chunk_host(host))
+    chunks2 = place_like(fresh_chunks, load_pytree(str(tmp_path / "params")))
+    state2 = place_like(opt_init(fresh_chunks),
+                        load_pytree(str(tmp_path / "opt")))
+    for _ in range(2):
+        chunks2, state2, _ = step(chunks2, state2, xd, yd)
+    got = fsdp.unchunk_host({k: np.asarray(v) for k, v in chunks2.items()})
+
+    for k in want:
+        np.testing.assert_array_equal(got[k], want[k], err_msg=k)
+
+
+def _roundtrip(tmp_path, make_fresh, step, params, state, batch, n_pre=2,
+               n_post=2):
+    """Run n_pre steps, checkpoint, restore onto fresh buffers, run n_post
+    more; returns the final (params, state)."""
+    for _ in range(n_pre):
+        params, state, _ = step(params, state, *batch)
+    save_pytree(str(tmp_path / "p"), params)
+    save_pytree(str(tmp_path / "s"), state)
+    fresh_params, fresh_state = make_fresh()
+    params = place_like(fresh_params, load_pytree(str(tmp_path / "p")))
+    state = place_like(fresh_state, load_pytree(str(tmp_path / "s")))
+    for _ in range(n_post):
+        params, state, _ = step(params, state, *batch)
+    return params, state
+
+
+@pytest.mark.parametrize("kind", ["pp", "ep", "lm"])
+def test_other_trainers_resume_bit_identical(kind, tmp_path):
+    """pp, ep, and the MoE LM trainers must also resume exactly."""
+    if kind == "pp":
+        from elephas_tpu.parallel.pipeline import (
+            PipelineDenseStack, build_mesh_pp, build_pp_train_step)
+
+        mesh = build_mesh_pp(data=2, pipe=4)
+        model = PipelineDenseStack(d_in=10, hidden=16, d_out=4, n_stages=4)
+        step, opt_init = build_pp_train_step(
+            model, mesh, optax.adam(1e-2), _softmax_xent, n_micro=4)
+        x, y = _task(seed=11)
+        batch = tuple(jax.device_put(a, NamedSharding(mesh, P("data")))
+                      for a in (x, y))
+        make = lambda: (model.shard_params(mesh, model.init(seed=1)),)
+    elif kind == "ep":
+        from elephas_tpu.parallel.expert import (
+            MoEFeedForward, build_mesh_ep, build_ep_train_step)
+
+        mesh = build_mesh_ep(data=2, expert=4)
+        model = MoEFeedForward(d_model=8, d_ff=16, n_experts=8, k=2)
+        step, opt_init = build_ep_train_step(
+            model, mesh, optax.adam(1e-2),
+            lambda a, b: jnp.sum((a - b) ** 2, -1))
+        rng = np.random.default_rng(12)
+        xt = rng.normal(size=(64, 8)).astype(np.float32)
+        spec = P(("data", "expert"))
+        batch = tuple(jax.device_put(a, NamedSharding(mesh, spec))
+                      for a in (xt, xt))
+        make = lambda: (model.shard_params(mesh, model.init(seed=1)),)
+    else:
+        from elephas_tpu.models.transformer import (
+            MoETransformerLM, build_lm_train_step, build_mesh_sp,
+            make_lm_batches, shard_lm_batch)
+
+        mesh = build_mesh_sp(data=2, seq=4)
+        model = MoETransformerLM(vocab=11, d_model=8, n_heads=4, n_layers=1,
+                                 d_ff=16, max_len=16, n_experts=4, k=1,
+                                 ep_groups=4)
+        step, opt_init = build_lm_train_step(model, mesh, optax.adam(1e-2),
+                                             attn="ring")
+        rows = np.random.default_rng(13).integers(0, 11, size=(4, 17))
+        batch = shard_lm_batch(mesh, *make_lm_batches(rows))
+        make = lambda: (model.shard_params(mesh, model.init(seed=1)),)
+
+    def make_fresh():
+        (p,) = make()
+        return p, opt_init(p)
+
+    # uninterrupted
+    params, state = make_fresh()
+    for _ in range(4):
+        params, state, _ = step(params, state, *batch)
+    want = {k: np.asarray(jax.device_get(v)) for k, v in params.items()}
+
+    # interrupted + resumed
+    params, state = make_fresh()
+    params, state = _roundtrip(tmp_path, make_fresh, step, params, state,
+                               batch)
+    for k, v in want.items():
+        np.testing.assert_array_equal(
+            np.asarray(jax.device_get(params[k])), v, err_msg=k)
+
+
+def test_non_numeric_leaf_rejected(tmp_path):
+    with pytest.raises(TypeError, match="non-numeric"):
+        save_pytree(str(tmp_path / "bad"), {"a": np.ones(3), "b": "label"})
+
+
+def test_tp_resume_is_bit_identical(tmp_path):
+    mesh = build_mesh2d(data=2, model=4)
+    model = TensorParallelMLP([10, 16, 8, 16, 4], tp=4)
+    step, opt_init = build_tp_train_step(
+        model, mesh, optax.adam(1e-2), _softmax_xent
+    )
+    x, y = _task(seed=5)
+    xd = jax.device_put(x, NamedSharding(mesh, P("data")))
+    yd = jax.device_put(y, NamedSharding(mesh, P("data")))
+    params0 = model.init(seed=1)
+
+    params = model.shard_params(mesh, params0)
+    state = opt_init(params)
+    for _ in range(4):
+        params, state, _ = step(params, state, xd, yd)
+    want = model.gather_params(params)
+
+    params = model.shard_params(mesh, params0)
+    state = opt_init(params)
+    for _ in range(2):
+        params, state, _ = step(params, state, xd, yd)
+    save_pytree(str(tmp_path / "p"), params)
+    save_pytree(str(tmp_path / "s"), state)
+
+    fresh = model.shard_params(mesh, model.init(seed=1))
+    params2 = place_like(fresh, load_pytree(str(tmp_path / "p")))
+    state2 = place_like(opt_init(fresh), load_pytree(str(tmp_path / "s")))
+    # restored leaves keep the sharded layout (model dim split over "model")
+    assert params2["w0"].sharding.spec == P(None, "model")
+    for _ in range(2):
+        params2, state2, _ = step(params2, state2, xd, yd)
+    got = model.gather_params(params2)
+
+    for k in want:
+        np.testing.assert_array_equal(got[k], want[k], err_msg=k)
