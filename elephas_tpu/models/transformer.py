@@ -66,7 +66,7 @@ class TransformerLM:
     """
 
     def __init__(self, vocab: int, d_model: int, n_heads: int, n_layers: int,
-                 d_ff: int, max_len: int):
+                 d_ff: int, max_len: int, compute_dtype: str = "float32"):
         if d_model % n_heads:
             raise ValueError(f"d_model {d_model} not divisible by {n_heads} heads")
         self.vocab = vocab
@@ -76,6 +76,12 @@ class TransformerLM:
         self.d_ff = d_ff
         self.max_len = max_len
         self.aux_weight = 0.0  # MoE variant sets a nonzero weight
+        # Mixed precision the TPU way: params/optimizer/logits/loss stay
+        # float32, block activations and matmuls run in compute_dtype
+        # ("bfloat16" doubles MXU rate); layernorm statistics and attention
+        # accumulators stay float32 regardless (the ring/ulysses bodies
+        # already accumulate in f32 for sub-f32 inputs).
+        self.compute_dtype = jnp.dtype(compute_dtype)
 
     def param_shapes(self) -> Dict[str, jax.ShapeDtypeStruct]:
         V, D, L, F, T = (self.vocab, self.d_model, self.n_layers, self.d_ff,
@@ -144,25 +150,32 @@ class TransformerLM:
         B, T = tokens.shape
         H = self.n_heads
         Dh = self.d_model // H
-        h = params["tok"][tokens] + params["pos"][positions]
+        cd = self.compute_dtype
+        h = (params["tok"][tokens] + params["pos"][positions]).astype(cd)
 
         def block(h, lp):
             # One compiled block scanned over the stacked [L, ...] axis —
-            # trace/compile cost stays constant in depth.
-            x = _layer_norm(h, lp["ln1_s"], lp["ln1_b"])
-            q = (x @ lp["wq"]).reshape(B, T, H, Dh)
-            k = (x @ lp["wk"]).reshape(B, T, H, Dh)
-            v = (x @ lp["wv"]).reshape(B, T, H, Dh)
-            a = self._attend(q, k, v, attn, seq_axis)
-            h = h + a.reshape(B, T, self.d_model) @ lp["wo"]
-            x = _layer_norm(h, lp["ln2_s"], lp["ln2_b"])
+            # trace/compile cost stays constant in depth. Weight matrices
+            # cast to the compute dtype at use; layernorm runs in f32.
+            x = _layer_norm(
+                h.astype(jnp.float32), lp["ln1_s"], lp["ln1_b"]
+            ).astype(cd)
+            q = (x @ lp["wq"].astype(cd)).reshape(B, T, H, Dh)
+            k = (x @ lp["wk"].astype(cd)).reshape(B, T, H, Dh)
+            v = (x @ lp["wv"].astype(cd)).reshape(B, T, H, Dh)
+            a = self._attend(q, k, v, attn, seq_axis).astype(cd)
+            h = h + a.reshape(B, T, self.d_model) @ lp["wo"].astype(cd)
+            x = _layer_norm(
+                h.astype(jnp.float32), lp["ln2_s"], lp["ln2_b"]
+            ).astype(cd)
             out, aux = self._ffn(lp, x, attn, seq_axis)
-            return h + out, aux
+            return h + out.astype(cd), aux
 
         h, auxes = jax.lax.scan(
             block, h, {k: params[k] for k in self._block_keys()}
         )
-        h = _layer_norm(h, params["lnf_s"], params["lnf_b"])
+        h = _layer_norm(h.astype(jnp.float32), params["lnf_s"],
+                        params["lnf_b"])
         return h @ params["head"], jnp.sum(auxes)
 
     def _block_keys(self):
@@ -171,10 +184,15 @@ class TransformerLM:
 
     def _ffn(self, lp, x, attn: str, seq_axis: str):
         """Per-block FFN hook → ``(residual_delta, aux_loss)``. The MoE
-        variant overrides this with routed experts."""
+        variant overrides this with routed experts (which keep f32 routing
+        regardless of ``compute_dtype`` — argmax ties must match the
+        oracle)."""
         del attn, seq_axis
-        out = jax.nn.relu(x @ lp["w1"] + lp["b1"]) @ lp["w2"] + lp["b2"]
-        return out, jnp.asarray(0.0, x.dtype)
+        cd = x.dtype
+        out = jax.nn.relu(
+            x @ lp["w1"].astype(cd) + lp["b1"].astype(cd)
+        ) @ lp["w2"].astype(cd) + lp["b2"].astype(cd)
+        return out, jnp.asarray(0.0, jnp.float32)
 
     def loss(self, params, tokens, positions, targets, attn="dense",
              seq_axis: str = SEQ_AXIS):
@@ -203,8 +221,9 @@ class MoETransformerLM(TransformerLM):
     def __init__(self, vocab: int, d_model: int, n_heads: int, n_layers: int,
                  d_ff: int, max_len: int, n_experts: int, k: int = 2,
                  capacity_factor: float = 1.25, aux_weight: float = 1e-2,
-                 ep_groups: int = 1):
-        super().__init__(vocab, d_model, n_heads, n_layers, d_ff, max_len)
+                 ep_groups: int = 1, compute_dtype: str = "float32"):
+        super().__init__(vocab, d_model, n_heads, n_layers, d_ff, max_len,
+                         compute_dtype=compute_dtype)
         from ..parallel.expert import MoEFeedForward
 
         self.moe = MoEFeedForward(d_model, d_ff, n_experts, k=k,

@@ -33,18 +33,28 @@ from ..parallel.mesh import DATA_AXIS
 
 
 def attention_reference(q, k, v, causal: bool = False):
-    """Plain full attention, the single-device oracle.
+    """Plain full attention, the single-device oracle (and the local body
+    Ulysses runs per head group).
 
-    ``q``/``k``/``v``: ``[B, T, H, D]``. Returns ``[B, T, H, D]``.
+    ``q``/``k``/``v``: ``[B, T, H, D]``. Returns ``[B, T, H, D]`` in the
+    input dtype. Scores, softmax, and the value sum accumulate in float32
+    even for bf16 inputs — summing a long sequence's normalizer in an
+    8-bit mantissa loses exactly the precision flash/ring practice warns
+    about, so every attention path in the package shares the f32 rule.
     """
     scale = q.shape[-1] ** -0.5
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
     if causal:
         tq, tk = scores.shape[-2], scores.shape[-1]
         mask = jnp.arange(tk)[None, :] <= jnp.arange(tq)[:, None]
         scores = jnp.where(mask, scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", probs, v, preferred_element_type=jnp.float32
+    )
+    return out.astype(q.dtype)
 
 
 def _ring_attention_local(q, k, v, causal: bool, axis_name: str):

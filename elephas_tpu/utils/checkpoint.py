@@ -73,10 +73,15 @@ def _load_tree(directory: str, leaves_name: str, treedef_name: str) -> Any:
 
 def save_checkpoint(directory: str, weights: List[np.ndarray],
                     meta: Dict[str, Any], opt_state: Any = None) -> None:
+    import jax
+
     os.makedirs(directory, exist_ok=True)
-    save_weights_npz(os.path.join(directory, "weights.npz"), weights)
     if opt_state is not None:
+        # collective gather first (all processes participate) …
         _save_tree(directory, opt_state, "opt_state.npz", "opt_treedef.pkl")
+    if jax.process_index() != 0:
+        return  # … then only process 0 writes files
+    save_weights_npz(os.path.join(directory, "weights.npz"), weights)
     with open(os.path.join(directory, "meta.json"), "w") as f:
         json.dump(meta, f)
 

@@ -1,0 +1,11 @@
+"""Shared test helpers (one home for the per-sample losses the parallel
+trainer tests all use)."""
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_xent(y, y_pred):
+    """Per-sample categorical cross-entropy from one-hot labels."""
+    logp = jax.nn.log_softmax(y_pred, axis=-1)
+    return -jnp.sum(y * logp, axis=-1)

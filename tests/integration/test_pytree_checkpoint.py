@@ -23,9 +23,7 @@ from elephas_tpu.parallel.tensor import (
 from elephas_tpu.utils.checkpoint import load_pytree, place_like, save_pytree
 
 
-def _softmax_xent(y, y_pred):
-    logp = jax.nn.log_softmax(y_pred, axis=-1)
-    return -jnp.sum(y * logp, axis=-1)
+from tests._helpers import softmax_xent as _softmax_xent  # noqa: E402
 
 
 def _task(seed=3, n=32, d=10, c=4):

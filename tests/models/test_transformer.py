@@ -119,6 +119,34 @@ def test_learns_synthetic_task():
     assert last < first * 0.5, (first, last)
 
 
+def test_bfloat16_compute():
+    """bf16 activations: forward stays close to f32, training still learns,
+    params/optimizer remain f32."""
+    f32 = _model()
+    bf16 = TransformerLM(vocab=17, d_model=16, n_heads=4, n_layers=2,
+                         d_ff=32, max_len=32, compute_dtype="bfloat16")
+    params = {k: jnp.asarray(v) for k, v in f32.init(seed=1).items()}
+    tokens, positions, targets = _data()
+    a = np.asarray(f32.apply(params, tokens, positions, attn="dense"))
+    b_raw = bf16.apply(params, tokens, positions, attn="dense")
+    assert b_raw.dtype == jnp.float32  # logits come back f32, pre-cast
+    np.testing.assert_allclose(a, np.asarray(b_raw), atol=0.15, rtol=0.1)
+
+    mesh = build_mesh_sp(data=2, seq=4)
+    step, opt_init = build_lm_train_step(bf16, mesh, optax.adam(3e-3),
+                                         attn="ring")
+    p = bf16.shard_params(mesh, bf16.init(seed=0))
+    s = opt_init(p)
+    td, pd, gd = shard_lm_batch(mesh, *_data(b=8))
+    first = last = None
+    for i in range(20):
+        p, s, loss = step(p, s, td, pd, gd)
+        first = float(loss) if i == 0 else first
+        last = float(loss)
+    assert p["wq"].dtype == jnp.float32  # master params stay f32
+    assert last < first * 0.7, (first, last)
+
+
 def test_head_divisibility_validation():
     with pytest.raises(ValueError, match="not divisible"):
         TransformerLM(vocab=10, d_model=15, n_heads=4, n_layers=1,

@@ -34,9 +34,7 @@ def _mlp_init(shapes, seed=0):
     }
 
 
-def _softmax_xent(y, y_pred):
-    logp = jax.nn.log_softmax(y_pred, axis=-1)
-    return -jnp.sum(y * logp, axis=-1)
+from tests._helpers import softmax_xent as _softmax_xent  # noqa: E402
 
 
 def test_chunk_roundtrip():

@@ -20,9 +20,7 @@ from elephas_tpu.parallel.tensor import (
 )
 
 
-def _softmax_xent(y, y_pred):
-    logp = jax.nn.log_softmax(y_pred, axis=-1)
-    return -jnp.sum(y * logp, axis=-1)
+from tests._helpers import softmax_xent as _softmax_xent  # noqa: E402
 
 
 @pytest.mark.parametrize("dp,tp", [(1, 8), (2, 4), (4, 2), (8, 1)])
