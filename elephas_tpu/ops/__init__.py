@@ -13,6 +13,7 @@ from .pallas_ops import (
     fused_xent_from_logits,
     xent_from_logits_reference,
 )
+from .flash_attention import flash_attention
 from .ring_attention import attention_reference, ring_attention
 from .ulysses import ulysses_attention
 
@@ -23,4 +24,5 @@ __all__ = [
     "ring_attention",
     "attention_reference",
     "ulysses_attention",
+    "flash_attention",
 ]

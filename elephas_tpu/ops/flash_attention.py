@@ -1,0 +1,200 @@
+"""Blockwise (flash-style) exact attention: no ``[T, T]`` materialization.
+
+``attention_reference`` builds the full score matrix — ``O(T²)`` HBM per
+head, the classic long-context wall. This module computes the same exact
+attention blockwise (Dao et al. 2022), forward AND backward:
+
+- forward: a ``lax.scan`` over KV blocks folding the online-softmax
+  ``(max, sum, acc)`` state (the same fold the ring body runs across
+  devices), peak memory ``O(T · block)``;
+- backward: a ``custom_vjp`` implementing the flash backward — residuals
+  are just ``(q, k, v, out, logsumexp)``; each KV block's probabilities are
+  RECOMPUTED from the saved logsumexp and folded into ``dq``/``dk``/``dv``,
+  so gradient memory is also ``O(T · block)``. Without the custom VJP,
+  differentiating the forward scan would store per-block residuals and
+  quietly regain the ``O(T²)`` this module exists to avoid.
+
+Accumulation is float32 regardless of input dtype (the package-wide rule —
+see ``attention_reference``). Used as the within-shard body of the Ulysses
+path (each head group holds the FULL sequence there, so its local attention
+is where ``[T, T]`` would otherwise appear); also usable standalone. The
+ring path needs nothing: its per-visit blocks are already ``T/P`` wide.
+
+Expressed in jnp rather than a hand-written Pallas kernel deliberately: the
+block bodies are a few matmuls + elementwise folds, which XLA fuses well on
+TPU, and the same code runs everywhere (CPU tests, interpret mode) with one
+source of truth.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _pick_block(t: int, block_size: int) -> int:
+    """Largest divisor of ``t`` not exceeding ``block_size`` (t prime → 1:
+    correct, just slow — callers control T)."""
+    blk = min(block_size, t)
+    while t % blk:
+        blk -= 1
+    return blk
+
+
+def _block_scores(qh, kb, j, blk, t, causal, scale):
+    """f32 scores of all queries against KV block ``j`` (masked)."""
+    scores = jnp.einsum(
+        "bhqd,bhkd->bhqk", qh, kb, preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST
+    ) * scale
+    if causal:
+        kpos = j * blk + jnp.arange(blk)
+        mask = kpos[None, :] <= jnp.arange(t)[:, None]  # [T, blk]
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    return scores
+
+
+def _heads_first(x):
+    return jnp.transpose(x, (0, 2, 1, 3))  # [B, T, H, D] → [B, H, T, D]
+
+
+def fold_softmax_block(scores, vj, m, l, acc):
+    """One online-softmax fold: merge a KV block's ``scores`` ``[B, H, Q, K]``
+    (f32, ``-inf`` = masked) and values ``vj`` ``[B, H, K, D]`` into the
+    running ``(max, normalizer, weighted-acc)`` state.
+
+    The single home for the numerically delicate ``isneginf`` guards — the
+    blockwise forward here and the ring body's cross-device fold both use
+    it, so the two schedules cannot drift apart.
+    """
+    m_blk = jnp.max(scores, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    corr = jnp.where(jnp.isneginf(m_new), 0.0, jnp.exp(m - m_new))
+    p = jnp.exp(scores - m_new[..., None])
+    p = jnp.where(jnp.isneginf(scores), 0.0, p)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    acc_new = acc * corr[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, vj, preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST
+    )
+    return m_new, l_new, acc_new
+
+
+def _kv_blocks(x, n_blocks, blk):
+    b, h, t, d = x.shape
+    return jnp.moveaxis(
+        x.reshape(b, h, n_blocks, blk, d), 2, 0
+    )  # [n, B, H, blk, D]
+
+
+def _flash_fwd_scan(qh, kh, vh, causal, blk, scale):
+    """Online-softmax forward → ``(out [B,H,T,D] f32, lse [B,H,T] f32)``."""
+    b, h, t, d = qh.shape
+    n_blocks = t // blk
+    kb = _kv_blocks(kh, n_blocks, blk)
+    vb = _kv_blocks(vh, n_blocks, blk)
+
+    def fold(carry, inputs):
+        m, l, acc = carry
+        j, kj, vj = inputs
+        scores = _block_scores(qh, kj, j, blk, t, causal, scale)
+        return fold_softmax_block(scores, vj, m, l, acc), None
+
+    m0 = jnp.full((b, h, t), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, h, t), jnp.float32)
+    acc0 = jnp.zeros((b, h, t, d), jnp.float32)
+    (m, l, acc), _ = jax.lax.scan(
+        fold, (m0, l0, acc0), (jnp.arange(n_blocks), kb, vb)
+    )
+    l = jnp.maximum(l, 1e-30)
+    return acc / l[..., None], m + jnp.log(l)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, causal, block_size):
+    out, _ = _flash_fwd_scan(
+        _heads_first(q), _heads_first(k), _heads_first(v),
+        causal, _pick_block(q.shape[1], block_size), q.shape[-1] ** -0.5,
+    )
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def _flash_fwd(q, k, v, causal, block_size):
+    qh, kh, vh = _heads_first(q), _heads_first(k), _heads_first(v)
+    out, lse = _flash_fwd_scan(
+        qh, kh, vh, causal, _pick_block(q.shape[1], block_size),
+        q.shape[-1] ** -0.5,
+    )
+    primal = jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+    return primal, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, block_size, residuals, g):
+    """Flash backward: recompute each block's probabilities from the saved
+    logsumexp; one scan carrying ``dq``, emitting per-block ``dk``/``dv``."""
+    q, k, v, out, lse = residuals
+    b, t, h, d = q.shape
+    blk = _pick_block(t, block_size)
+    n_blocks = t // blk
+    scale = d ** -0.5
+    qh, kh, vh = _heads_first(q), _heads_first(k), _heads_first(v)
+    gh = _heads_first(g).astype(jnp.float32)
+    kb = _kv_blocks(kh, n_blocks, blk)
+    vb = _kv_blocks(vh, n_blocks, blk)
+    # D_i = Σ_d dout·out — the softmax-jacobian diagonal term (flash2 eq. 4)
+    delta = jnp.sum(gh * out, axis=-1)  # [B, H, T]
+
+    def fold(dq, inputs):
+        j, kj, vj = inputs
+        scores = _block_scores(qh, kj, j, blk, t, causal, scale)
+        p = jnp.exp(scores - lse[..., None])  # exp(-inf)=0 handles masks
+        dv_j = jnp.einsum(
+            "bhqk,bhqd->bhkd", p, gh, preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST
+        )
+        dp = jnp.einsum(
+            "bhqd,bhkd->bhqk", gh, vj, preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST
+        )
+        ds = p * (dp - delta[..., None])
+        dq = dq + jnp.einsum(
+            "bhqk,bhkd->bhqd", ds, kj, preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST
+        ) * scale
+        dk_j = jnp.einsum(
+            "bhqk,bhqd->bhkd", ds, qh, preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST
+        ) * scale
+        return dq, (dk_j, dv_j)
+
+    dq0 = jnp.zeros((b, h, t, d), jnp.float32)
+    dq, (dk, dv) = jax.lax.scan(
+        fold, dq0, (jnp.arange(n_blocks), kb, vb)
+    )
+
+    def back(x_blocks, dtype):  # [n, B, H, blk, D] → [B, T, H, D]
+        x = jnp.moveaxis(x_blocks, 0, 2).reshape(b, h, t, d)
+        return jnp.transpose(x, (0, 2, 1, 3)).astype(dtype)
+
+    return (
+        jnp.transpose(dq, (0, 2, 1, 3)).astype(q.dtype),
+        back(dk, k.dtype),
+        back(dv, v.dtype),
+    )
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False, block_size: int = 128):
+    """Exact attention via online softmax over KV blocks, ``O(T · block)``
+    memory in BOTH directions (see module docstring).
+
+    ``q``/``k``/``v``: ``[B, T, H, D]``; any ``T`` works (the block size
+    falls back to the largest divisor ≤ ``block_size``). Equals
+    :func:`~elephas_tpu.ops.ring_attention.attention_reference` to float32
+    accumulation, gradients included.
+    """
+    return _flash(q, k, v, causal, block_size)

@@ -30,11 +30,13 @@ import jax
 import jax.numpy as jnp
 
 from ..parallel.mesh import DATA_AXIS
+from .flash_attention import fold_softmax_block
 
 
 def attention_reference(q, k, v, causal: bool = False):
-    """Plain full attention, the single-device oracle (and the local body
-    Ulysses runs per head group).
+    """Plain full attention — the single-device test oracle (the Ulysses
+    local body uses blockwise ``flash_attention`` instead, avoiding this
+    function's ``[T, T]`` score matrix).
 
     ``q``/``k``/``v``: ``[B, T, H, D]``. Returns ``[B, T, H, D]`` in the
     input dtype. Scores, softmax, and the value sum accumulate in float32
@@ -44,7 +46,8 @@ def attention_reference(q, k, v, causal: bool = False):
     """
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum(
-        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST
     ) * scale
     if causal:
         tq, tk = scores.shape[-2], scores.shape[-1]
@@ -52,7 +55,8 @@ def attention_reference(q, k, v, causal: bool = False):
         scores = jnp.where(mask, scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(
-        "bhqk,bkhd->bqhd", probs, v, preferred_element_type=jnp.float32
+        "bhqk,bkhd->bqhd", probs, v, preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST
     )
     return out.astype(q.dtype)
 
@@ -69,26 +73,21 @@ def _ring_attention_local(q, k, v, causal: bool, axis_name: str):
 
     def fold_block(j, m, l, acc, kb, vb):
         """Fold the visiting KV block (which started at rank ``rank - j``)
-        into the float32 online-softmax state."""
+        into the float32 online-softmax state (shared fold — the
+        ``isneginf`` guard logic lives once, in
+        ``flash_attention.fold_softmax_block``)."""
         src = (rank - j) % p
         scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, kb, preferred_element_type=jnp.float32
+            "bqhd,bkhd->bhqk", q, kb, preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST
         ) * scale
         if causal:
             kpos = src * tk + jnp.arange(tk)
             mask = kpos[None, :] <= qpos[:, None]  # [Tq, Tk]
             scores = jnp.where(mask[None, None], scores, -jnp.inf)
-        m_blk = jnp.max(scores, axis=-1)  # [B, H, Tq]
-        m_new = jnp.maximum(m, m_blk)
-        # exp(-inf - -inf) guards: where m_new is -inf nothing has been seen
-        corr = jnp.where(jnp.isneginf(m_new), 0.0, jnp.exp(m - m_new))
-        p_blk = jnp.exp(scores - m_new[..., None])
-        p_blk = jnp.where(jnp.isneginf(scores), 0.0, p_blk)
-        l_new = l * corr + jnp.sum(p_blk, axis=-1)
-        acc_new = acc * corr[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p_blk, vb, preferred_element_type=jnp.float32
+        return fold_softmax_block(
+            scores, jnp.transpose(vb, (0, 2, 1, 3)), m, l, acc
         )
-        return m_new, l_new, acc_new
 
     def step(j, carry):
         m, l, acc, kb, vb = carry

@@ -5,9 +5,10 @@ no long-context support of any kind, SURVEY.md §5.7). DeepSpeed-Ulysses
 (Jacobs et al. 2023) is the second canonical sequence-parallel schedule, the
 all-to-all complement to the ring: activations arrive sharded over the
 SEQUENCE dim, one ``all_to_all`` re-shards them over the HEAD dim (each
-device then holds the FULL sequence for ``H/P`` heads), plain dense attention
-runs locally with no inter-step communication, and a second ``all_to_all``
-restores sequence sharding. Communication is two all-to-alls of the
+device then holds the FULL sequence for ``H/P`` heads), blockwise flash
+attention runs locally with no inter-step communication (``O(T · block)``
+memory — no ``[T, T]`` matrix; see ``flash_attention.py``), and a second
+``all_to_all`` restores sequence sharding. Communication is two all-to-alls of the
 activation volume per call — ``O(T·H·D/P)`` per chip — versus the ring's
 ``P`` nearest-neighbor KV hops; on a TPU torus the ring wins for very long
 sequences at small head counts, Ulysses wins when heads are plentiful and
@@ -27,7 +28,8 @@ from functools import partial
 import jax
 
 from ..parallel.mesh import DATA_AXIS
-from .ring_attention import attention_reference, sharded_seq_attention
+from .flash_attention import flash_attention
+from .ring_attention import sharded_seq_attention
 
 
 def _ulysses_local(q, k, v, causal: bool, axis_name: str):
@@ -39,7 +41,9 @@ def _ulysses_local(q, k, v, causal: bool, axis_name: str):
         concat_axis=1, tiled=True,
     )
     qh, kh, vh = a2a(q), a2a(k), a2a(v)
-    out = attention_reference(qh, kh, vh, causal=causal)
+    # full sequence per head group here — blockwise flash keeps the local
+    # attention O(T·block) instead of materializing [T, T]
+    out = flash_attention(qh, kh, vh, causal=causal)
     # seq-full/head-sharded → seq-sharded/head-full
     return jax.lax.all_to_all(
         out, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True

@@ -1,0 +1,65 @@
+"""Blockwise flash attention vs the dense oracle."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from elephas_tpu.ops.flash_attention import flash_attention
+from elephas_tpu.ops.ring_attention import attention_reference
+
+
+def _qkv(b=2, t=64, h=4, d=16, seed=0):
+    rng = np.random.default_rng(seed)
+    mk = lambda: rng.normal(size=(b, t, h, d)).astype("float32")
+    return mk(), mk(), mk()
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("block", [8, 16, 64])
+def test_matches_dense(causal, block):
+    q, k, v = _qkv()
+    got = np.asarray(flash_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        causal=causal, block_size=block,
+    ))
+    want = np.asarray(attention_reference(q, k, v, causal=causal))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_odd_length_falls_back_to_divisor_block():
+    q, k, v = _qkv(t=48)  # 48 % 128 != 0 → blk becomes 48
+    got = np.asarray(flash_attention(*map(jnp.asarray, (q, k, v)),
+                                     causal=True, block_size=128))
+    want = np.asarray(attention_reference(q, k, v, causal=True))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_gradients_match(causal):
+    q, k, v = _qkv(b=1, t=32, h=2, d=8)
+
+    def loss_flash(q):
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                       block_size=8) ** 2)
+
+    def loss_ref(q):
+        return jnp.sum(attention_reference(q, k, v, causal=causal) ** 2)
+
+    g1 = np.asarray(jax.grad(loss_flash)(jnp.asarray(q)))
+    g2 = np.asarray(jax.grad(loss_ref)(jnp.asarray(q)))
+    np.testing.assert_allclose(g1, g2, atol=2e-4, rtol=2e-4)
+
+
+def test_bf16_accumulates_f32():
+    q, k, v = _qkv()
+    ref = np.asarray(attention_reference(q, k, v, causal=True))
+    qb = jnp.asarray(q, jnp.bfloat16)
+    kb = jnp.asarray(k, jnp.bfloat16)
+    vb = jnp.asarray(v, jnp.bfloat16)
+    out = flash_attention(qb, kb, vb, causal=True, block_size=16)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), ref, atol=5e-2, rtol=5e-2
+    )
