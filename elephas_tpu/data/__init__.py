@@ -1,4 +1,5 @@
 from .dataframe import DataFrame, Row, SparkSession
+from .native_loader import NativeBatchLoader
 from .rdd import RDD, Broadcast, SparkConf, SparkContext
 
 __all__ = [
@@ -9,4 +10,5 @@ __all__ = [
     "DataFrame",
     "Row",
     "SparkSession",
+    "NativeBatchLoader",
 ]

@@ -1,0 +1,48 @@
+"""Shared build/load plumbing for the native (C++) runtime components.
+
+One home for the compile-on-first-use logic the parameter server and the
+data loader both need: ``make`` the shared library under ``native/build/``
+if absent, ``ctypes.CDLL`` it, run the component's signature-configuration
+hook, and cache per library name (double-checked under one lock).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable, Dict
+
+NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native"
+)
+_lock = threading.Lock()
+_libs: Dict[str, ctypes.CDLL] = {}
+
+
+def load_native_library(lib_name: str,
+                        configure: Callable[[ctypes.CDLL], None]) -> ctypes.CDLL:
+    """Load ``native/build/<lib_name>`` (building via ``make`` if needed),
+    apply ``configure(lib)`` to declare restype/argtypes, and cache."""
+    lib = _libs.get(lib_name)
+    if lib is not None:
+        return lib
+    with _lock:
+        lib = _libs.get(lib_name)
+        if lib is not None:
+            return lib
+        path = os.path.join(NATIVE_DIR, "build", lib_name)
+        if not os.path.exists(path):
+            proc = subprocess.run(
+                ["make", "-C", NATIVE_DIR], capture_output=True, text=True
+            )
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"native build failed (make -C {NATIVE_DIR}):\n"
+                    f"{proc.stderr[-2000:]}"
+                )
+        lib = ctypes.CDLL(path)
+        configure(lib)
+        _libs[lib_name] = lib
+        return lib

@@ -24,6 +24,15 @@ Expressed in jnp rather than a hand-written Pallas kernel deliberately: the
 block bodies are a few matmuls + elementwise folds, which XLA fuses well on
 TPU, and the same code runs everywhere (CPU tests, interpret mode) with one
 source of truth.
+
+Matmul precision: every attention einsum in the package pins
+``Precision.HIGHEST``. On TPU the default would multiply in bf16 even for
+f32 operands (``preferred_element_type`` only sets the accumulator), which
+drifts blockwise vs dense results by ~1e-3. For the recommended perf
+configuration — bf16 activations (``compute_dtype="bfloat16"``) — HIGHEST
+costs nothing: bf16×bf16 products are exact and accumulate in f32 either
+way. Only f32-activation models pay the multi-pass cost, and they are
+paying for the documented f32-exact semantics.
 """
 
 from __future__ import annotations

@@ -17,10 +17,8 @@ restored to their original dtype on the way out.
 from __future__ import annotations
 
 import ctypes
-import os
 import socket
 import struct
-import subprocess
 import threading
 from typing import List, Optional
 
@@ -28,47 +26,32 @@ import numpy as np
 
 from .client import BaseParameterClient
 
-_NATIVE_DIR = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
-    "native",
-)
-_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libeps.so")
-_build_lock = threading.Lock()
-_lib = None
+from ..native_build import load_native_library
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    lib.eps_create.restype = ctypes.c_void_p
+    lib.eps_create.argtypes = [ctypes.c_int]
+    lib.eps_start.restype = ctypes.c_int
+    lib.eps_start.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.eps_set_weights.argtypes = [
+        ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+    ]
+    lib.eps_num_arrays.restype = ctypes.c_int
+    lib.eps_num_arrays.argtypes = [ctypes.c_void_p]
+    lib.eps_array_size.restype = ctypes.c_int64
+    lib.eps_array_size.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.eps_get_array.argtypes = [
+        ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_float)
+    ]
+    lib.eps_stop.argtypes = [ctypes.c_void_p]
+    lib.eps_destroy.argtypes = [ctypes.c_void_p]
 
 
 def _load_library() -> ctypes.CDLL:
-    global _lib
-    if _lib is not None:
-        return _lib
-    with _build_lock:
-        if _lib is not None:
-            return _lib
-        if not os.path.exists(_LIB_PATH):
-            subprocess.run(
-                ["make", "-C", _NATIVE_DIR], check=True, capture_output=True
-            )
-        lib = ctypes.CDLL(_LIB_PATH)
-        lib.eps_create.restype = ctypes.c_void_p
-        lib.eps_create.argtypes = [ctypes.c_int]
-        lib.eps_start.restype = ctypes.c_int
-        lib.eps_start.argtypes = [ctypes.c_void_p, ctypes.c_int]
-        lib.eps_set_weights.argtypes = [
-            ctypes.c_void_p, ctypes.c_int,
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
-        ]
-        lib.eps_num_arrays.restype = ctypes.c_int
-        lib.eps_num_arrays.argtypes = [ctypes.c_void_p]
-        lib.eps_array_size.restype = ctypes.c_int64
-        lib.eps_array_size.argtypes = [ctypes.c_void_p, ctypes.c_int]
-        lib.eps_get_array.argtypes = [
-            ctypes.c_void_p, ctypes.c_int, ctypes.POINTER(ctypes.c_float)
-        ]
-        lib.eps_stop.argtypes = [ctypes.c_void_p]
-        lib.eps_destroy.argtypes = [ctypes.c_void_p]
-        _lib = lib
-        return _lib
+    return load_native_library("libeps.so", _configure)
 
 
 def native_available() -> bool:

@@ -1,0 +1,198 @@
+// Native prefetching batch loader.
+//
+// The reference's per-epoch data plane is Python: workers materialize their
+// partition, then Keras shuffles and slices batches on the GIL-bound host
+// thread (elephas/worker.py:~25 materialization; Keras fit's index
+// shuffling). This is the TPU build's native equivalent for the host paths:
+// Fisher-Yates shuffle + permuted row gather + batch assembly run on C++
+// worker threads into a ring of preallocated slots, so the Python thread
+// only memcpy-consumes ready batches (and the GIL is never held during
+// gather). The compiled engine path doesn't need this — whole epochs live
+// on-device — but the reference-faithful host workers and any custom
+// training loop feeding jax.device_put do.
+//
+// extern "C" API (ctypes-friendly; see elephas_tpu/data/native_loader.py):
+//   dl_open(x, y, n, x_row, y_row, batch, n_prefetch, n_threads) -> handle
+//   dl_start_epoch(handle, seed)     begin shuffled epoch (drops prior state)
+//   dl_next(handle, x_out, y_out)    -> batch rows filled, 0 at epoch end
+//   dl_close(handle)
+//
+// The caller OWNS x/y (numpy buffers) and must keep them alive until
+// dl_close; rows are float32, row-major, x_row/y_row floats per row. The
+// final partial batch is returned with its true row count.
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <random>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Slot {
+  std::vector<float> x, y;
+  int64_t rows = 0;
+  int64_t index = -1;  // batch index this slot holds; -1 = empty
+  bool busy = false;   // a worker is gathering into it (survives epoch reset)
+};
+
+struct Loader {
+  const float *x = nullptr, *y = nullptr;
+  int64_t n = 0, x_row = 0, y_row = 0, batch = 0;
+  // generation-owned permutation: stale workers keep their epoch's vector
+  // alive through the shared_ptr they copied under the lock
+  std::shared_ptr<const std::vector<int64_t>> perm;
+
+  std::vector<Slot> slots;
+  int64_t n_batches = 0;
+  int64_t next_fill = 0;     // next batch index a worker will gather
+  int64_t next_serve = 0;    // next batch index dl_next hands out
+  int64_t epoch_gen = 0;     // bumped per start_epoch; stale fills discard
+  bool closing = false;
+
+  std::mutex mu;
+  std::condition_variable cv_fill, cv_serve;
+  std::vector<std::thread> workers;
+};
+
+void worker_loop(Loader *L) {
+  std::unique_lock<std::mutex> lk(L->mu);
+  for (;;) {
+    int64_t gen = L->epoch_gen;
+    // wait for a batch to gather and a free slot to gather into
+    int64_t bi = -1;
+    Slot *slot = nullptr;
+    for (;;) {
+      if (L->closing) return;
+      if (L->epoch_gen == gen && L->next_fill < L->n_batches) {
+        int64_t want = L->next_fill;
+        Slot &s = L->slots[want % (int64_t)L->slots.size()];
+        // claimable once its previous batch was served AND no (possibly
+        // stale) worker is still writing its buffers
+        if (!s.busy && s.index < L->next_serve) {
+          bi = want;
+          slot = &s;
+          L->next_fill++;
+          slot->index = bi;
+          slot->rows = 0;  // consumers must wait until rows > 0
+          slot->busy = true;
+          break;
+        }
+      }
+      L->cv_fill.wait(lk);
+      gen = L->epoch_gen;
+    }
+
+    // gather outside the lock; this generation's perm is pinned by the
+    // shared_ptr copy, and `busy` keeps the slot ours across epoch resets
+    auto perm = L->perm;
+    const int64_t start = bi * L->batch;
+    const int64_t rows = std::min(L->batch, L->n - start);
+    lk.unlock();
+    for (int64_t r = 0; r < rows; ++r) {
+      const int64_t src = (*perm)[(size_t)(start + r)];
+      std::memcpy(slot->x.data() + r * L->x_row, L->x + src * L->x_row,
+                  sizeof(float) * L->x_row);
+      std::memcpy(slot->y.data() + r * L->y_row, L->y + src * L->y_row,
+                  sizeof(float) * L->y_row);
+    }
+    lk.lock();
+    slot->busy = false;
+    if (L->epoch_gen == gen) {
+      slot->rows = rows;  // publish
+      L->cv_serve.notify_all();
+    } else {
+      // epoch restarted mid-gather: contents are stale, slot is reusable
+      L->cv_fill.notify_all();
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void *dl_open(const float *x, const float *y, int64_t n, int64_t x_row,
+              int64_t y_row, int64_t batch, int64_t n_prefetch,
+              int64_t n_threads) {
+  if (n <= 0 || batch <= 0 || x_row <= 0 || y_row <= 0) return nullptr;
+  auto *L = new Loader;
+  L->x = x;
+  L->y = y;
+  L->n = n;
+  L->x_row = x_row;
+  L->y_row = y_row;
+  L->batch = batch;
+  L->n_batches = 0;  // no epoch yet
+  if (n_prefetch < 2) n_prefetch = 2;
+  L->slots.resize((size_t)n_prefetch);
+  for (auto &s : L->slots) {
+    s.x.resize((size_t)(batch * x_row));
+    s.y.resize((size_t)(batch * y_row));
+  }
+  if (n_threads < 1) n_threads = 1;
+  for (int64_t i = 0; i < n_threads; ++i)
+    L->workers.emplace_back(worker_loop, L);
+  return L;
+}
+
+void dl_start_epoch(void *h, int64_t seed) {
+  auto *L = static_cast<Loader *>(h);
+  std::unique_lock<std::mutex> lk(L->mu);
+  L->epoch_gen++;
+  auto perm = std::make_shared<std::vector<int64_t>>((size_t)L->n);
+  for (int64_t i = 0; i < L->n; ++i) (*perm)[(size_t)i] = i;
+  std::mt19937_64 rng((uint64_t)seed);
+  for (int64_t i = L->n - 1; i > 0; --i) {
+    std::uniform_int_distribution<int64_t> d(0, i);
+    std::swap((*perm)[(size_t)i], (*perm)[(size_t)d(rng)]);
+  }
+  L->perm = std::move(perm);
+  L->n_batches = (L->n + L->batch - 1) / L->batch;
+  L->next_fill = 0;
+  L->next_serve = 0;
+  for (auto &s : L->slots) {
+    s.index = -1;  // busy flags intentionally survive (stale gathers)
+    s.rows = 0;
+  }
+  L->cv_fill.notify_all();
+}
+
+int64_t dl_next(void *h, float *x_out, float *y_out) {
+  auto *L = static_cast<Loader *>(h);
+  std::unique_lock<std::mutex> lk(L->mu);
+  if (L->next_serve >= L->n_batches) return 0;  // epoch done
+  const int64_t want = L->next_serve;
+  Slot &s = L->slots[want % (int64_t)L->slots.size()];
+  while (!(s.index == want && s.rows > 0)) {
+    if (L->closing) return -1;
+    L->cv_serve.wait(lk);
+  }
+  const int64_t rows = s.rows;
+  // copy WITHOUT the lock: workers cannot claim this slot until next_serve
+  // advances past it, so the consumer owns it for the duration
+  lk.unlock();
+  std::memcpy(x_out, s.x.data(), sizeof(float) * (size_t)(rows * L->x_row));
+  std::memcpy(y_out, s.y.data(), sizeof(float) * (size_t)(rows * L->y_row));
+  lk.lock();
+  L->next_serve++;
+  L->cv_fill.notify_all();  // the slot just freed
+  return rows;
+}
+
+void dl_close(void *h) {
+  auto *L = static_cast<Loader *>(h);
+  {
+    std::lock_guard<std::mutex> lk(L->mu);
+    L->closing = true;
+  }
+  L->cv_fill.notify_all();
+  L->cv_serve.notify_all();
+  for (auto &t : L->workers) t.join();
+  delete L;
+}
+
+}  // extern "C"
