@@ -1,3 +1,8 @@
+from .composite import (
+    TensorPipelineStack,
+    build_3d_train_step,
+    build_mesh_3d,
+)
 from .distributed import global_mesh, initialize_cluster
 from .engine import CompiledTrainer, FitResult
 from .expert import (
@@ -35,6 +40,9 @@ __all__ = [
     "build_tp_train_step",
     "column_parallel_dense",
     "row_parallel_dense",
+    "build_mesh_3d",
+    "TensorPipelineStack",
+    "build_3d_train_step",
     "FSDPParams",
     "build_fsdp_train_step",
     "EXPERT_AXIS",

@@ -1,0 +1,176 @@
+"""3-D composite parallelism: data × pipeline × tensor in ONE program.
+
+EXTENSION BEYOND THE REFERENCE (which is dp-only, SURVEY.md §2.3). The 2-D
+extensions each add one axis to data parallelism; this module composes three
+— a ``("data", "pipe", "model")`` mesh where the batch shards over
+``"data"``, GPipe microbatches stream through stages over ``"pipe"``
+(``parallel/pipeline.py``'s machinery, unchanged — ``pipeline_apply`` is
+axis-generic), and every stage's internals are Megatron column→row pairs
+sharded over ``"model"`` (``parallel/tensor.py``'s primitives, unchanged).
+One ``shard_map`` program, one XLA executable; this is the classic
+"3D parallelism" layout (Megatron-LM + GPipe + DP) on a TPU mesh.
+
+Gradient collectives by parameter class (each restores exactly the sharding
+invariant, verified against the dense single-device oracle):
+
+- stage TP weights (column/row shards): owned per (pipe, model) rank pair —
+  the reverse pipeline delivers pipe-local cotangents and the custom-vjp
+  psum transposes (tensor.py) deliver model-local ones; ``psum`` over
+  ``"data"`` only.
+- replicated in/out projections: nonzero only on the first/last pipe rank
+  and identical across model ranks (the column layer's backward psums the
+  input cotangent over ``"model"``, so every model rank holds the full
+  value); ``psum`` over ``"pipe"`` restores pipe replication — summing over
+  ``"model"`` too would overcount by the tp degree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS
+from .param_utils import gather_host, glorot, shard_by_specs
+from .pipeline import PIPE_AXIS, build_staged_train_step, pipeline_apply
+from .tensor import MODEL_AXIS, column_parallel_dense, row_parallel_dense
+
+
+def build_mesh_3d(data: int = 1, pipe: int = 1, model: int = 1,
+                  devices: Optional[Sequence] = None) -> Mesh:
+    """A 3-D ``("data", "pipe", "model")`` mesh. ``model`` is innermost, so
+    the per-pair psums ride nearest-neighbor ICI; the pipe ring sits above
+    it; data groups are outermost."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    need = data * pipe * model
+    if need > len(devs) or need < 1 or min(data, pipe, model) < 1:
+        raise ValueError(
+            f"mesh {data}x{pipe}x{model} needs {need} devices, "
+            f"have {len(devs)}"
+        )
+    grid = np.array(devs[:need]).reshape(data, pipe, model)
+    return Mesh(grid, (DATA_AXIS, PIPE_AXIS, MODEL_AXIS))
+
+
+class TensorPipelineStack:
+    """Pipelined stack whose stages are Megatron column→row pairs.
+
+    ``n_stages`` stages, each ``pairs_per_stage`` column→row Dense pairs of
+    width ``hidden`` (hidden activations relu, sharded over ``"model"``
+    inside the pair, replicated at pair boundaries — so stages stay
+    shape-homogeneous for the pipeline's rotating buffer). Replicated
+    ``d_in → hidden`` / ``hidden → d_out`` projections bracket the ring.
+    ``hidden`` must divide by the tp degree.
+    """
+
+    def __init__(self, d_in: int, hidden: int, d_out: int, n_stages: int,
+                 pairs_per_stage: int = 1, activation=jax.nn.relu):
+        if n_stages < 1 or pairs_per_stage < 1:
+            raise ValueError("n_stages and pairs_per_stage must be >= 1")
+        self.d_in = d_in
+        self.hidden = hidden
+        self.d_out = d_out
+        self.n_stages = n_stages
+        self.pairs_per_stage = pairs_per_stage
+        self.activation = activation
+
+    def param_shapes(self) -> Dict[str, jax.ShapeDtypeStruct]:
+        S, G, h = self.n_stages, self.pairs_per_stage, self.hidden
+        f32 = jnp.float32
+        sds = jax.ShapeDtypeStruct
+        return {
+            "win": sds((self.d_in, h), f32),
+            "bin": sds((h,), f32),
+            "wc": sds((S, G, h, h), f32),  # column: out dim model-sharded
+            "bc": sds((S, G, h), f32),
+            "wr": sds((S, G, h, h), f32),  # row: in dim model-sharded
+            "br": sds((S, G, h), f32),
+            "wout": sds((h, self.d_out), f32),
+            "bout": sds((self.d_out,), f32),
+        }
+
+    def init(self, seed: int = 0) -> Dict[str, np.ndarray]:
+        rng = np.random.default_rng(seed)
+        return {
+            name: glorot(rng, *sds.shape, dtype=sds.dtype)
+            if name.startswith("w") else np.zeros(sds.shape, sds.dtype)
+            for name, sds in self.param_shapes().items()
+        }
+
+    def specs(self) -> Dict[str, P]:
+        """Stage stacks: dim 0 over ``"pipe"``; column weights shard their
+        OUTPUT (last) dim and row weights their INPUT (second-last) dim over
+        ``"model"``; row biases replicate over model."""
+        return {
+            "win": P(), "bin": P(),
+            "wc": P(PIPE_AXIS, None, None, MODEL_AXIS),
+            "bc": P(PIPE_AXIS, None, MODEL_AXIS),
+            "wr": P(PIPE_AXIS, None, MODEL_AXIS, None),
+            "br": P(PIPE_AXIS, None, None),
+            "wout": P(), "bout": P(),
+        }
+
+    def shard_params(self, mesh: Mesh, params: Dict[str, Any]) -> Dict[str, Any]:
+        return shard_by_specs(mesh, self.specs(), params)
+
+    def gather_params(self, params: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        return gather_host(params)
+
+    def _stage_fn(self, stage_params, x):
+        """One stage: ``pairs_per_stage`` column→row pairs over local model
+        shards. ``stage_params`` = ``(wc [G,h,h/TP], bc [G,h/TP],
+        wr [G,h/TP,h], br [G,h])``."""
+        wc, bc, wr, br = stage_params
+        h = x
+        for g in range(self.pairs_per_stage):
+            part = column_parallel_dense(h, wc[g], bc[g],
+                                         activation=self.activation)
+            h = row_parallel_dense(part, wr[g], br[g],
+                                   activation=self.activation)
+        return h
+
+    def apply(self, params: Dict[str, Any], x, n_micro: int):
+        """Forward INSIDE shard_map: stage stacks are local
+        ``[1, G, ...]`` pipe×model shards."""
+        h = self.activation(jnp.dot(x, params["win"]) + params["bin"])
+        h = pipeline_apply(
+            self._stage_fn,
+            (params["wc"][0], params["bc"][0], params["wr"][0],
+             params["br"][0]),
+            h, n_micro,
+        )
+        return jnp.dot(h, params["wout"]) + params["bout"]
+
+    def apply_reference(self, params: Dict[str, Any], x):
+        """Single-device dense oracle (no mesh, no microbatching)."""
+        h = self.activation(jnp.dot(x, params["win"]) + params["bin"])
+        for s in range(self.n_stages):
+            for g in range(self.pairs_per_stage):
+                h = self.activation(jnp.dot(h, params["wc"][s, g])
+                                    + params["bc"][s, g])
+                h = self.activation(jnp.dot(h, params["wr"][s, g])
+                                    + params["br"][s, g])
+        return jnp.dot(h, params["wout"]) + params["bout"]
+
+
+def build_3d_train_step(model: TensorPipelineStack, mesh: Mesh, optimizer,
+                        per_sample_loss, n_micro: int):
+    """Compile one dp×pp×tp gradient-synchronous training step (contract as
+    the other builders; see the module docstring for the collective map)."""
+    if mesh.shape[PIPE_AXIS] != model.n_stages:
+        raise ValueError(
+            f"pipe axis size {mesh.shape[PIPE_AXIS]} != n_stages "
+            f"{model.n_stages} (one stage per pipe rank)"
+        )
+    if model.hidden % mesh.shape[MODEL_AXIS]:
+        raise ValueError(
+            f"hidden {model.hidden} not divisible by model axis "
+            f"{mesh.shape[MODEL_AXIS]}"
+        )
+    return build_staged_train_step(
+        model, mesh, optimizer, per_sample_loss, n_micro,
+        stage_keys=("wc", "bc", "wr", "br"),
+    )

@@ -221,10 +221,24 @@ def build_pp_train_step(model: PipelineDenseStack, mesh: Mesh, optimizer,
             f"pipe axis size {mesh.shape[PIPE_AXIS]} != n_stages "
             f"{model.n_stages} (one stage per pipe rank)"
         )
+    return build_staged_train_step(
+        model, mesh, optimizer, per_sample_loss, n_micro,
+        stage_keys=("w", "b"),
+    )
+
+
+def build_staged_train_step(model, mesh: Mesh, optimizer, per_sample_loss,
+                            n_micro: int, stage_keys):
+    """Shared step builder for pipelined models (``build_pp_train_step`` and
+    ``composite.build_3d_train_step``): ``model`` needs ``apply(params, x,
+    n_micro)``, ``specs()``, ``param_shapes()``. ``stage_keys`` are the
+    pipe-owned params whose gradients skip the pipe-axis psum; all other
+    params are pipe-replicated and get one. Additional mesh axes inside the
+    stage (e.g. ``"model"``) manage their own collectives via the stage's
+    primitives."""
     pspecs = model.specs()
     sspecs = opt_state_specs(optimizer, model.param_shapes(), pspecs)
     data_spec = P(DATA_AXIS)
-    stage_keys = ("w", "b")
 
     def step_impl(params, opt_state, x, y):
         prank = jax.lax.axis_index(PIPE_AXIS)
