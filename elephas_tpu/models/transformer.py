@@ -221,13 +221,26 @@ class MoETransformerLM(TransformerLM):
     def __init__(self, vocab: int, d_model: int, n_heads: int, n_layers: int,
                  d_ff: int, max_len: int, n_experts: int, k: int = 2,
                  capacity_factor: float = 1.25, aux_weight: float = 1e-2,
-                 ep_groups: int = 1, compute_dtype: str = "float32"):
+                 ep_groups: int = 1, compute_dtype: str = "float32",
+                 routing: str = "token_choice"):
         super().__init__(vocab, d_model, n_heads, n_layers, d_ff, max_len,
                          compute_dtype=compute_dtype)
         from ..parallel.expert import MoEFeedForward
 
+        if routing == "expert_choice":
+            # Expert-choice makes token t's routing depend on FUTURE tokens
+            # (experts pick top-C across the whole block), so training-time
+            # routing differs from autoregressive inference — the EC paper
+            # itself flags it as unsuitable for decoder LMs.
+            raise ValueError(
+                "routing='expert_choice' breaks causality in a decoder LM "
+                "(routing would depend on future tokens); use "
+                "'token_choice' here, or MoEFeedForward directly for "
+                "non-causal workloads"
+            )
         self.moe = MoEFeedForward(d_model, d_ff, n_experts, k=k,
-                                  capacity_factor=capacity_factor)
+                                  capacity_factor=capacity_factor,
+                                  routing=routing)
         self.n_experts = n_experts
         self.aux_weight = aux_weight
         self.ep_groups = int(ep_groups)

@@ -99,6 +99,21 @@ def _top_k_dispatch(gates, capacity: int, k: int):
     return dispatch, combine, aux
 
 
+def _expert_choice_dispatch(gates, capacity: int):
+    """Expert-choice routing (Zhou et al. 2022): each EXPERT picks its
+    top-``capacity`` tokens by gate score (ties break to the lowest token
+    index — ``lax.top_k`` is deterministic, so shard and oracle agree);
+    the combine weight is the gate score itself. Load is perfectly balanced
+    by construction — every expert processes exactly ``capacity`` slots —
+    so no auxiliary loss is needed; tokens may be picked by 0..E experts.
+
+    Returns ``(dispatch [E, C, N] one-hot, combine [E, C, N] weights)``.
+    """
+    vals, idx = jax.lax.top_k(gates.T, capacity)  # [E, C] over tokens
+    dispatch = jax.nn.one_hot(idx, gates.shape[0], dtype=gates.dtype)
+    return dispatch, dispatch * vals[..., None]
+
+
 class MoEFeedForward:
     """Top-k routed expert FFN (``D → F → D`` per expert, relu).
 
@@ -112,14 +127,18 @@ class MoEFeedForward:
     """
 
     def __init__(self, d_model: int, d_ff: int, n_experts: int, k: int = 2,
-                 capacity_factor: float = 1.25):
+                 capacity_factor: float = 1.25,
+                 routing: str = "token_choice"):
         if n_experts < k:
             raise ValueError(f"need n_experts >= k, got {n_experts} < {k}")
+        if routing not in ("token_choice", "expert_choice"):
+            raise ValueError(f"Unknown routing: {routing}")
         self.d_model = d_model
         self.d_ff = d_ff
         self.n_experts = n_experts
         self.k = k
         self.capacity_factor = capacity_factor
+        self.routing = routing
 
     def param_shapes(self) -> Dict[str, jax.ShapeDtypeStruct]:
         """Full (unsharded) shape/dtype per param — the shape-only source for
@@ -173,15 +192,21 @@ class MoEFeedForward:
         Returns ``(y [N_l, D], aux_loss scalar)`` — aux is the Switch
         load-balancing loss computed from group-global counts (psummed over
         ``axis_name``), so it equals the oracle's value exactly."""
-        p = jax.lax.axis_size(axis_name)
         n_l = x.shape[0]
         cap = self.capacity(n_l)
         gates = jax.nn.softmax(jnp.dot(x, params["wg"]), axis=-1)
-        dispatch, combine, (c1, gsum, ntok) = _top_k_dispatch(
-            gates, cap, self.k
-        )
-        # [N_l, E, C] × [N_l, D] → [E, C, D]
-        blocks = jnp.einsum("nec,nd->ecd", dispatch, x)
+        if self.routing == "expert_choice":
+            # an expert cannot pick more tokens than the shard holds
+            ec_dispatch, ec_combine = _expert_choice_dispatch(
+                gates, min(cap, n_l)
+            )
+            blocks = jnp.einsum("ecn,nd->ecd", ec_dispatch, x)
+        else:
+            dispatch, combine, (c1, gsum, ntok) = _top_k_dispatch(
+                gates, cap, self.k
+            )
+            # [N_l, E, C] × [N_l, D] → [E, C, D]
+            blocks = jnp.einsum("nec,nd->ecd", dispatch, x)
         # E→local experts, gather the P source shards' slots:
         # [E, C, D] → [E/P, P·C, D]
         blocks = jax.lax.all_to_all(
@@ -194,6 +219,10 @@ class MoEFeedForward:
         out = jax.lax.all_to_all(
             out, axis_name, split_axis=1, concat_axis=0, tiled=True
         )
+        if self.routing == "expert_choice":
+            # perfectly balanced by construction → no aux loss
+            return (jnp.einsum("ecn,ecd->nd", ec_combine, out),
+                    jnp.asarray(0.0, jnp.float32))
         y = jnp.einsum("nec,ecd->nd", combine, out)
         # Switch aux loss on group-global stats: E · Σ_e f_e · p_e
         c1 = jax.lax.psum(c1, axis_name)
@@ -220,16 +249,24 @@ class MoEFeedForward:
         ys, c1s, gsums = [], [], []
         for blk in jnp.split(x, ep, axis=0):
             gates = jax.nn.softmax(jnp.dot(blk, params["wg"]), axis=-1)
-            dispatch, combine, (c1, gsum, _) = _top_k_dispatch(
-                gates, cap, self.k
-            )
-            w = jnp.sum(combine, axis=-1)  # [Nb, E] kept combine weights
+            if self.routing == "expert_choice":
+                _, ec_combine = _expert_choice_dispatch(
+                    gates, min(cap, blk.shape[0])
+                )
+                w = jnp.sum(ec_combine, axis=1).T  # [Nb, E] summed weights
+            else:
+                dispatch, combine, (c1, gsum, _) = _top_k_dispatch(
+                    gates, cap, self.k
+                )
+                w = jnp.sum(combine, axis=-1)  # [Nb, E] kept combine weights
+                c1s.append(c1)
+                gsums.append(gsum)
             out_all = jax.vmap(
                 self._expert_ffn, in_axes=(0, 0, 0, 0, None)
             )(params["w1"], params["b1"], params["w2"], params["b2"], blk)
             ys.append(jnp.einsum("ne,end->nd", w, out_all))
-            c1s.append(c1)
-            gsums.append(gsum)
+        if self.routing == "expert_choice":
+            return jnp.concatenate(ys, axis=0), jnp.asarray(0.0, jnp.float32)
         c1 = sum(c1s)
         gsum = sum(gsums)
         aux = self.n_experts * jnp.sum((c1 / n) * (gsum / n))

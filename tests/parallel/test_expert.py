@@ -127,9 +127,61 @@ def test_train_step_matches_oracle(dp, ep):
         )
 
 
+@pytest.mark.parametrize("dp,ep", [(1, 8), (2, 4)])
+def test_expert_choice_forward_matches_oracle(dp, ep):
+    mesh = build_mesh_ep(data=dp, expert=ep)
+    model = MoEFeedForward(d_model=8, d_ff=16, n_experts=8, k=2,
+                           capacity_factor=1.0, routing="expert_choice")
+    params = model.init(seed=1)
+    x = _tokens(n=64, d=8)
+
+    outs = []
+    for blk in np.split(x, dp, axis=0):
+        y, aux = model.apply_reference(params, jnp.asarray(blk), ep=ep)
+        assert float(aux) == 0.0  # balanced by construction, no aux
+        outs.append(np.asarray(y))
+    want = np.concatenate(outs, axis=0)
+
+    sharded = model.shard_params(mesh, params)
+    token_spec = P(("data", "expert"))
+    fwd = jax.jit(
+        jax.shard_map(
+            lambda p, xb: model.apply(p, xb)[0], mesh=mesh,
+            in_specs=(model.specs(), token_spec), out_specs=token_spec,
+            check_vma=False,
+        )
+    )
+    xd = jax.device_put(x, NamedSharding(mesh, token_spec))
+    got = np.asarray(fwd(sharded, xd))
+    np.testing.assert_allclose(got, want, rtol=3e-5, atol=3e-5)
+
+
+def test_expert_choice_trains():
+    """Dropless routing must train through build_ep_train_step unchanged."""
+    mesh = build_mesh_ep(data=2, expert=4)
+    model = MoEFeedForward(d_model=8, d_ff=16, n_experts=8, k=2,
+                           routing="expert_choice")
+    step, opt_init = build_ep_train_step(model, mesh, optax.adam(1e-2), _mse)
+    params = model.shard_params(mesh, model.init(seed=2))
+    state = opt_init(params)
+    rng = np.random.default_rng(5)
+    x = _tokens(n=64, d=8, seed=5)
+    y = rng.normal(size=(64, 8)).astype(np.float32)
+    token_spec = P(("data", "expert"))
+    xd = jax.device_put(x, NamedSharding(mesh, token_spec))
+    yd = jax.device_put(y, NamedSharding(mesh, token_spec))
+    losses = []
+    for _ in range(8):
+        params, state, loss = step(params, state, xd, yd)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
 def test_validation():
     with pytest.raises(ValueError):
         MoEFeedForward(d_model=4, d_ff=8, n_experts=1, k=2)
+    with pytest.raises(ValueError, match="routing"):
+        MoEFeedForward(d_model=4, d_ff=8, n_experts=4, routing="soft")
     mesh = build_mesh_ep(data=1, expert=8)
     model = MoEFeedForward(d_model=4, d_ff=8, n_experts=6, k=1)
     with pytest.raises(ValueError, match="not divisible"):
