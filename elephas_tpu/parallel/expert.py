@@ -174,7 +174,13 @@ class MoEFeedForward:
         return gather_host(params)
 
     def capacity(self, n_shard: int) -> int:
-        """Per-(expert, source-shard) slot count for ``n_shard`` local tokens."""
+        """Per-(expert, source-shard) slot count for ``n_shard`` local
+        tokens: ``ceil(cf · k · n / E)`` for BOTH routings. Under
+        token-choice, ``k`` is the per-token expert count the buffer must
+        absorb; under expert-choice there is no per-token top-k — ``k``
+        instead sets the target MEAN experts per token (the EC paper's
+        capacity knob), so ``k=2, cf=1.0`` gives each expert ``2n/E``
+        slots."""
         return max(
             1, int(math.ceil(self.capacity_factor * self.k * n_shard
                              / self.n_experts))

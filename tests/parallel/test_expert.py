@@ -82,11 +82,12 @@ def test_capacity_drops_tokens():
     assert nonzero <= 4
 
 
-@pytest.mark.parametrize("dp,ep", [(2, 4)])
-def test_train_step_matches_oracle(dp, ep):
+@pytest.mark.parametrize("dp,ep,routing", [(2, 4, "token_choice"),
+                                           (2, 4, "expert_choice")])
+def test_train_step_matches_oracle(dp, ep, routing):
     mesh = build_mesh_ep(data=dp, expert=ep)
     model = MoEFeedForward(d_model=8, d_ff=16, n_experts=8, k=2,
-                           capacity_factor=2.0)
+                           capacity_factor=2.0, routing=routing)
     optimizer = optax.adam(1e-2)
     aux_w = 1e-2
     params = model.init(seed=2)
