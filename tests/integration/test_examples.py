@@ -22,6 +22,8 @@ EXAMPLES = [
     "ml_pipeline_otto.py",
     "ml_pipeline_imdb_lstm.py",
     "hyperparam_optimization.py",
+    "transformer_lm.py",
+    "parallelism_tour.py",
 ]
 
 
