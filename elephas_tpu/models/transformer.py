@@ -182,12 +182,14 @@ class TransformerLM:
         return ("ln1_s", "ln1_b", "wq", "wk", "wv", "wo",
                 "ln2_s", "ln2_b", "w1", "b1", "w2", "b2")
 
-    def _ffn(self, lp, x, attn: str, seq_axis: str):
+    def _ffn(self, lp, x, attn: str, seq_axis: str,
+             ep_groups: Optional[int] = None):
         """Per-block FFN hook → ``(residual_delta, aux_loss)``. The MoE
         variant overrides this with routed experts (which keep f32 routing
         regardless of ``compute_dtype`` — argmax ties must match the
-        oracle)."""
-        del attn, seq_axis
+        oracle); ``ep_groups`` overrides its dense-path dispatch grouping
+        (decode passes 1 — a single position has no groups)."""
+        del attn, seq_axis, ep_groups
         cd = x.dtype
         out = jax.nn.relu(
             x @ lp["w1"].astype(cd) + lp["b1"].astype(cd)
@@ -201,6 +203,146 @@ class TransformerLM:
         logp = jax.nn.log_softmax(logits, axis=-1)
         ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
         return -jnp.sum(ll)
+
+    # -- autoregressive inference (KV cache) ----------------------------
+    def init_cache(self, batch: int, length: Optional[int] = None) -> Dict[str, Any]:
+        """Zeroed KV cache ``{"k"/"v": [L, B, length, H, Dh]}`` (``length``
+        defaults to ``max_len``; size it to the actual decode horizon —
+        every step attends over the whole cache)."""
+        L, H = self.n_layers, self.n_heads
+        T = self.max_len if length is None else int(length)
+        shape = (L, batch, T, H, self.d_model // H)
+        z = jnp.zeros(shape, self.compute_dtype)
+        return {"k": z, "v": z}
+
+    def prefill(self, params, tokens, cache):
+        """Batched prompt ingestion: run the full (matrix-matrix) forward
+        over ``tokens`` ``[B, T0]``, writing every position's K/V into
+        ``cache`` at offset 0. Returns ``(logits [B, T0, V], cache)``."""
+        B, T0 = tokens.shape
+        H = self.n_heads
+        Dh = self.d_model // H
+        cd = self.compute_dtype
+        positions = jnp.broadcast_to(jnp.arange(T0), (B, T0))
+        h = (params["tok"][tokens] + params["pos"][positions]).astype(cd)
+
+        def block(h, lp):
+            x = _layer_norm(
+                h.astype(jnp.float32), lp["ln1_s"], lp["ln1_b"]
+            ).astype(cd)
+            q = (x @ lp["wq"].astype(cd)).reshape(B, T0, H, Dh)
+            k = (x @ lp["wk"].astype(cd)).reshape(B, T0, H, Dh)
+            v = (x @ lp["wv"].astype(cd)).reshape(B, T0, H, Dh)
+            a = attention_reference(q, k, v, causal=True).astype(cd)
+            h = h + a.reshape(B, T0, self.d_model) @ lp["wo"].astype(cd)
+            x = _layer_norm(
+                h.astype(jnp.float32), lp["ln2_s"], lp["ln2_b"]
+            ).astype(cd)
+            out, _ = self._ffn(lp, x, "dense", SEQ_AXIS, ep_groups=1)
+            return h + out.astype(cd), (k, v)
+
+        lps = {k: params[k] for k in self._block_keys()}
+        h, (ks, vs) = jax.lax.scan(block, h, lps)  # ks/vs [L, B, T0, H, Dh]
+        cache = {
+            "k": jax.lax.dynamic_update_slice_in_dim(cache["k"], ks, 0, axis=2),
+            "v": jax.lax.dynamic_update_slice_in_dim(cache["v"], vs, 0, axis=2),
+        }
+        h = _layer_norm(h.astype(jnp.float32), params["lnf_s"],
+                        params["lnf_b"])
+        return h @ params["head"], cache
+
+    def decode_step(self, params, token, pos, cache):
+        """One cached decode step: ``token`` ``[B]`` int at absolute
+        position ``pos`` (scalar) → ``(logits [B, V] f32, new_cache)``.
+        Attends over cache positions ``0..pos``; for the dense model this
+        is bit-close to the teacher-forced forward one position at a time.
+        The MoE variant routes each decoded position as its OWN dispatch
+        group (the causally correct choice — no future competition), which
+        intentionally differs from teacher-forced whole-block routing."""
+        B = token.shape[0]
+        H = self.n_heads
+        Dh = self.d_model // H
+        cd = self.compute_dtype
+        scale = Dh ** -0.5
+        cache_len = cache["k"].shape[2]
+        h = (params["tok"][token] + params["pos"][pos]).astype(cd)  # [B, D]
+        pos_mask = (jnp.arange(cache_len) <= pos)[None, None, :]  # [1,1,T]
+
+        def block(h, inputs):
+            lp, kc, vc = inputs  # layer params; cache slices [B, T, H, Dh]
+            x = _layer_norm(
+                h.astype(jnp.float32), lp["ln1_s"], lp["ln1_b"]
+            ).astype(cd)
+            q = (x @ lp["wq"].astype(cd)).reshape(B, H, Dh)
+            k_new = (x @ lp["wk"].astype(cd)).reshape(B, 1, H, Dh)
+            v_new = (x @ lp["wv"].astype(cd)).reshape(B, 1, H, Dh)
+            kc = jax.lax.dynamic_update_slice_in_dim(kc, k_new, pos, axis=1)
+            vc = jax.lax.dynamic_update_slice_in_dim(vc, v_new, pos, axis=1)
+            scores = jnp.einsum(
+                "bhd,bthd->bht", q, kc, preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            ) * scale
+            scores = jnp.where(pos_mask, scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1)
+            a = jnp.einsum(
+                "bht,bthd->bhd", probs, vc,
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            ).astype(cd)
+            h = h + a.reshape(B, self.d_model) @ lp["wo"].astype(cd)
+            x = _layer_norm(
+                h.astype(jnp.float32), lp["ln2_s"], lp["ln2_b"]
+            ).astype(cd)
+            out, _ = self._ffn(lp, x[:, None, :], "dense", SEQ_AXIS,
+                               ep_groups=1)
+            return h + out[:, 0].astype(cd), (kc, vc)
+
+        lps = {k: params[k] for k in self._block_keys()}
+        h, (kc_new, vc_new) = jax.lax.scan(
+            block, h, (lps, cache["k"], cache["v"])
+        )
+        h = _layer_norm(h.astype(jnp.float32), params["lnf_s"],
+                        params["lnf_b"])
+        return h @ params["head"], {"k": kc_new, "v": vc_new}
+
+    def generate(self, params, prompt, n_new: int):
+        """Greedy autoregressive continuation: ``prompt`` ``[B, T0]`` int →
+        ``[B, T0 + n_new]``. Single-device inference on full (gathered)
+        params: one batched :meth:`prefill` over the prompt, then a
+        ``lax.scan`` of KV-cached decode steps — the cache is sized to the
+        decode horizon, not ``max_len``. For the dense model the output
+        equals the uncached argmax rollout exactly; the MoE variant decodes
+        too, with per-position routing (see :meth:`decode_step`)."""
+        prompt = jnp.asarray(prompt, jnp.int32)
+        B, T0 = prompt.shape
+        total = T0 + int(n_new)
+        if total > self.max_len:
+            raise ValueError(
+                f"prompt {T0} + n_new {n_new} exceeds max_len {self.max_len}"
+            )
+        if n_new < 1:
+            return prompt
+        logits, cache = self.prefill(
+            params, prompt, self.init_cache(B, total)
+        )
+        first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        buf = jnp.zeros((B, total), jnp.int32)
+        buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
+        buf = buf.at[:, T0].set(first)
+
+        def step(carry, t):
+            buf, cache, token = carry
+            logits, cache = self.decode_step(params, token, t, cache)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            buf = jax.lax.dynamic_update_slice_in_dim(
+                buf, nxt[:, None], t + 1, axis=1
+            )
+            return (buf, cache, nxt), None
+
+        (buf, _, _), _ = jax.lax.scan(
+            step, (buf, cache, first), jnp.arange(T0, total - 1)
+        )
+        return buf
 
 
 class MoETransformerLM(TransformerLM):
@@ -265,7 +407,8 @@ class MoETransformerLM(TransformerLM):
         return ("ln1_s", "ln1_b", "wq", "wk", "wv", "wo",
                 "ln2_s", "ln2_b", "wg", "w1", "b1", "w2", "b2")
 
-    def _ffn(self, lp, x, attn: str, seq_axis: str):
+    def _ffn(self, lp, x, attn: str, seq_axis: str,
+             ep_groups: Optional[int] = None):
         B, T = x.shape[0], x.shape[1]
         moe_params = {k_: lp[k_] for k_ in ("wg", "w1", "b1", "w2", "b2")}
         if attn != "dense":
@@ -276,7 +419,8 @@ class MoETransformerLM(TransformerLM):
         # chunk flattened batch-major (exactly how a shard flattens its
         # local block) — re-layout so MoEFeedForward.apply_reference's
         # contiguous per-group emulation sees the same token groups.
-        G = self.ep_groups
+        # ``ep_groups=1`` (decode/prefill) treats the block as one group.
+        G = self.ep_groups if ep_groups is None else ep_groups
         if T % G:
             raise ValueError(f"T={T} not divisible by ep_groups={G}")
         tl = T // G
