@@ -147,29 +147,16 @@ class TransformerLM:
         """Like :meth:`apply` but also returns the summed auxiliary loss
         (0.0 for the dense-FFN base model; the MoE variant's load-balancing
         term)."""
-        B, T = tokens.shape
-        H = self.n_heads
-        Dh = self.d_model // H
         cd = self.compute_dtype
         h = (params["tok"][tokens] + params["pos"][positions]).astype(cd)
 
         def block(h, lp):
-            # One compiled block scanned over the stacked [L, ...] axis —
-            # trace/compile cost stays constant in depth. Weight matrices
-            # cast to the compute dtype at use; layernorm runs in f32.
-            x = _layer_norm(
-                h.astype(jnp.float32), lp["ln1_s"], lp["ln1_b"]
-            ).astype(cd)
-            q = (x @ lp["wq"].astype(cd)).reshape(B, T, H, Dh)
-            k = (x @ lp["wk"].astype(cd)).reshape(B, T, H, Dh)
-            v = (x @ lp["wv"].astype(cd)).reshape(B, T, H, Dh)
-            a = self._attend(q, k, v, attn, seq_axis).astype(cd)
-            h = h + a.reshape(B, T, self.d_model) @ lp["wo"].astype(cd)
-            x = _layer_norm(
-                h.astype(jnp.float32), lp["ln2_s"], lp["ln2_b"]
-            ).astype(cd)
-            out, aux = self._ffn(lp, x, attn, seq_axis)
-            return h + out.astype(cd), aux
+            h, aux, _, _ = self._block_fwd(
+                h, lp,
+                lambda q, k, v: self._attend(q, k, v, attn, seq_axis),
+                attn, seq_axis,
+            )
+            return h, aux
 
         h, auxes = jax.lax.scan(
             block, h, {k: params[k] for k in self._block_keys()}
@@ -177,6 +164,31 @@ class TransformerLM:
         h = _layer_norm(h.astype(jnp.float32), params["lnf_s"],
                         params["lnf_b"])
         return h @ params["head"], jnp.sum(auxes)
+
+    def _block_fwd(self, h, lp, attend, attn: str, seq_axis: str,
+                   ep_groups: Optional[int] = None):
+        """One transformer block on ``h`` ``[B, T, D]`` — THE single source
+        of the block math (scanned over the stacked ``[L, ...]`` params by
+        the teacher-forced forward and by ``prefill``, which also needs the
+        per-layer K/V). Weight matrices cast to the compute dtype at use;
+        layernorm runs in f32. Returns ``(h_new, aux, k, v)``."""
+        B, T = h.shape[0], h.shape[1]
+        H = self.n_heads
+        Dh = self.d_model // H
+        cd = self.compute_dtype
+        x = _layer_norm(
+            h.astype(jnp.float32), lp["ln1_s"], lp["ln1_b"]
+        ).astype(cd)
+        q = (x @ lp["wq"].astype(cd)).reshape(B, T, H, Dh)
+        k = (x @ lp["wk"].astype(cd)).reshape(B, T, H, Dh)
+        v = (x @ lp["wv"].astype(cd)).reshape(B, T, H, Dh)
+        a = attend(q, k, v).astype(cd)
+        h = h + a.reshape(B, T, self.d_model) @ lp["wo"].astype(cd)
+        x = _layer_norm(
+            h.astype(jnp.float32), lp["ln2_s"], lp["ln2_b"]
+        ).astype(cd)
+        out, aux = self._ffn(lp, x, attn, seq_axis, ep_groups=ep_groups)
+        return h + out.astype(cd), aux, k, v
 
     def _block_keys(self):
         return ("ln1_s", "ln1_b", "wq", "wk", "wv", "wo",
@@ -220,26 +232,17 @@ class TransformerLM:
         over ``tokens`` ``[B, T0]``, writing every position's K/V into
         ``cache`` at offset 0. Returns ``(logits [B, T0, V], cache)``."""
         B, T0 = tokens.shape
-        H = self.n_heads
-        Dh = self.d_model // H
         cd = self.compute_dtype
         positions = jnp.broadcast_to(jnp.arange(T0), (B, T0))
         h = (params["tok"][tokens] + params["pos"][positions]).astype(cd)
 
         def block(h, lp):
-            x = _layer_norm(
-                h.astype(jnp.float32), lp["ln1_s"], lp["ln1_b"]
-            ).astype(cd)
-            q = (x @ lp["wq"].astype(cd)).reshape(B, T0, H, Dh)
-            k = (x @ lp["wk"].astype(cd)).reshape(B, T0, H, Dh)
-            v = (x @ lp["wv"].astype(cd)).reshape(B, T0, H, Dh)
-            a = attention_reference(q, k, v, causal=True).astype(cd)
-            h = h + a.reshape(B, T0, self.d_model) @ lp["wo"].astype(cd)
-            x = _layer_norm(
-                h.astype(jnp.float32), lp["ln2_s"], lp["ln2_b"]
-            ).astype(cd)
-            out, _ = self._ffn(lp, x, "dense", SEQ_AXIS, ep_groups=1)
-            return h + out.astype(cd), (k, v)
+            h, _, k, v = self._block_fwd(
+                h, lp,
+                lambda q, k, v: attention_reference(q, k, v, causal=True),
+                "dense", SEQ_AXIS, ep_groups=1,
+            )
+            return h, (k, v)
 
         lps = {k: params[k] for k in self._block_keys()}
         h, (ks, vs) = jax.lax.scan(block, h, lps)  # ks/vs [L, B, T0, H, Dh]
@@ -305,14 +308,21 @@ class TransformerLM:
                         params["lnf_b"])
         return h @ params["head"], {"k": kc_new, "v": vc_new}
 
-    def generate(self, params, prompt, n_new: int):
-        """Greedy autoregressive continuation: ``prompt`` ``[B, T0]`` int →
+    def generate(self, params, prompt, n_new: int,
+                 temperature: float = 0.0, top_k: Optional[int] = None,
+                 seed: int = 0):
+        """Autoregressive continuation: ``prompt`` ``[B, T0]`` int →
         ``[B, T0 + n_new]``. Single-device inference on full (gathered)
         params: one batched :meth:`prefill` over the prompt, then a
         ``lax.scan`` of KV-cached decode steps — the cache is sized to the
-        decode horizon, not ``max_len``. For the dense model the output
-        equals the uncached argmax rollout exactly; the MoE variant decodes
-        too, with per-position routing (see :meth:`decode_step`)."""
+        decode horizon, not ``max_len``.
+
+        ``temperature=0`` (default) is greedy — for the dense model the
+        output then equals the uncached argmax rollout exactly; ``>0``
+        samples from ``softmax(logits / temperature)``, optionally
+        restricted to the ``top_k`` highest-probability tokens,
+        deterministically per ``seed``. The MoE variant decodes too, with
+        per-position routing (see :meth:`decode_step`)."""
         prompt = jnp.asarray(prompt, jnp.int32)
         B, T0 = prompt.shape
         total = T0 + int(n_new)
@@ -320,27 +330,44 @@ class TransformerLM:
             raise ValueError(
                 f"prompt {T0} + n_new {n_new} exceeds max_len {self.max_len}"
             )
+        if top_k is not None and not 1 <= int(top_k) <= self.vocab:
+            raise ValueError(
+                f"top_k must be in [1, vocab={self.vocab}], got {top_k}"
+            )
         if n_new < 1:
             return prompt
+
+        def select(logits, key):
+            if temperature <= 0.0:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            logits = logits / temperature
+            if top_k is not None:
+                kth = jax.lax.top_k(logits, int(top_k))[0][:, -1:]
+                logits = jnp.where(logits >= kth, logits, -jnp.inf)
+            return jax.random.categorical(key, logits).astype(jnp.int32)
+
+        key = jax.random.PRNGKey(seed)
+        key, k0 = jax.random.split(key)
         logits, cache = self.prefill(
             params, prompt, self.init_cache(B, total)
         )
-        first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        first = select(logits[:, -1], k0)
         buf = jnp.zeros((B, total), jnp.int32)
         buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
         buf = buf.at[:, T0].set(first)
 
         def step(carry, t):
-            buf, cache, token = carry
+            buf, cache, token, key = carry
             logits, cache = self.decode_step(params, token, t, cache)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            key, kt = jax.random.split(key)
+            nxt = select(logits, kt)
             buf = jax.lax.dynamic_update_slice_in_dim(
                 buf, nxt[:, None], t + 1, axis=1
             )
-            return (buf, cache, nxt), None
+            return (buf, cache, nxt, key), None
 
-        (buf, _, _), _ = jax.lax.scan(
-            step, (buf, cache, first), jnp.arange(T0, total - 1)
+        (buf, _, _, _), _ = jax.lax.scan(
+            step, (buf, cache, first, key), jnp.arange(T0, total - 1)
         )
         return buf
 

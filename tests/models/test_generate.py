@@ -83,11 +83,39 @@ def test_bf16_generate_matches_its_own_rollout():
     np.testing.assert_array_equal(out, seq)
 
 
-def test_generate_validates_length():
+def test_sampling_modes():
+    """temperature=0 is greedy; sampling is seed-deterministic, in-vocab,
+    and top_k=1 collapses back to greedy."""
+    model = _model()
+    params = {k: jnp.asarray(v) for k, v in model.init(seed=4).items()}
+    prompt = np.array([[5, 6, 7]], np.int32)
+
+    greedy = np.asarray(model.generate(params, prompt, n_new=6))
+    a = np.asarray(model.generate(params, prompt, n_new=6,
+                                  temperature=1.5, seed=7))
+    b = np.asarray(model.generate(params, prompt, n_new=6,
+                                  temperature=1.5, seed=7))
+    c = np.asarray(model.generate(params, prompt, n_new=6,
+                                  temperature=1.5, seed=8))
+    np.testing.assert_array_equal(a, b)  # same seed → same draw
+    assert not np.array_equal(a, c) or not np.array_equal(b, greedy)
+    assert np.all((a >= 0) & (a < 17))
+    np.testing.assert_array_equal(a[:, :3], prompt)
+
+    topk1 = np.asarray(model.generate(params, prompt, n_new=6,
+                                      temperature=1.5, top_k=1, seed=9))
+    np.testing.assert_array_equal(topk1, greedy)
+
+
+def test_generate_validates_length_and_top_k():
     model = _model(max_len=8)
     params = {k: jnp.asarray(v) for k, v in model.init().items()}
     with pytest.raises(ValueError, match="exceeds max_len"):
         model.generate(params, np.zeros((1, 6), np.int32), n_new=4)
+    for bad in (0, 100):
+        with pytest.raises(ValueError, match="top_k"):
+            model.generate(params, np.zeros((1, 2), np.int32), n_new=2,
+                           temperature=1.0, top_k=bad)
 
 
 @pytest.mark.parametrize("ep_groups", [1, 4])
