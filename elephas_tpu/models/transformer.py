@@ -56,6 +56,28 @@ def _layer_norm(x, scale, bias, eps: float = 1e-5):
     return (x - mu) * jax.lax.rsqrt(var + eps) * scale + bias
 
 
+def _rope_angles(positions, dh: int):
+    """RoPE angles for absolute ``positions`` ``[...]`` → ``(cos, sin)``
+    each ``[..., dh/2]`` (Su et al. 2021, base 10000)."""
+    half = dh // 2
+    inv_freq = 10000.0 ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[..., None] * inv_freq
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def _rope_rotate(x, cos, sin):
+    """Rotate head vectors ``x`` ``[..., H, Dh]`` by per-position angles
+    ``cos``/``sin`` ``[..., 1, Dh/2]`` (broadcast over heads). Pairing is
+    HALF-SPLIT (NeoX-style): dim ``i`` rotates with dim ``i + Dh/2`` — NOT
+    the interleaved even/odd layout some RoPE checkpoints use; permute
+    accordingly when importing foreign weights."""
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
 class TransformerLM:
     """Decoder-only LM: embed → L pre-norm blocks (attn + FFN) → norm → head.
 
@@ -66,9 +88,17 @@ class TransformerLM:
     """
 
     def __init__(self, vocab: int, d_model: int, n_heads: int, n_layers: int,
-                 d_ff: int, max_len: int, compute_dtype: str = "float32"):
+                 d_ff: int, max_len: int, compute_dtype: str = "float32",
+                 pos_encoding: str = "learned"):
         if d_model % n_heads:
             raise ValueError(f"d_model {d_model} not divisible by {n_heads} heads")
+        if pos_encoding not in ("learned", "rotary"):
+            raise ValueError(f"Unknown pos_encoding: {pos_encoding}")
+        if pos_encoding == "rotary" and (d_model // n_heads) % 2:
+            raise ValueError(
+                f"rotary needs an even head dim, got {d_model // n_heads}"
+            )
+        self.pos_encoding = pos_encoding
         self.vocab = vocab
         self.d_model = d_model
         self.n_heads = n_heads
@@ -88,9 +118,8 @@ class TransformerLM:
                          self.max_len)
         f32 = jnp.float32
         sds = jax.ShapeDtypeStruct
-        return {
+        shapes = {
             "tok": sds((V, D), f32),
-            "pos": sds((T, D), f32),
             "ln1_s": sds((L, D), f32), "ln1_b": sds((L, D), f32),
             "wq": sds((L, D, D), f32), "wk": sds((L, D, D), f32),
             "wv": sds((L, D, D), f32), "wo": sds((L, D, D), f32),
@@ -100,6 +129,9 @@ class TransformerLM:
             "lnf_s": sds((D,), f32), "lnf_b": sds((D,), f32),
             "head": sds((D, V), f32),
         }
+        if self.pos_encoding == "learned":
+            shapes["pos"] = sds((T, D), f32)
+        return shapes
 
     def init(self, seed: int = 0) -> Dict[str, np.ndarray]:
         rng = np.random.default_rng(seed)
@@ -147,14 +179,14 @@ class TransformerLM:
         """Like :meth:`apply` but also returns the summed auxiliary loss
         (0.0 for the dense-FFN base model; the MoE variant's load-balancing
         term)."""
-        cd = self.compute_dtype
-        h = (params["tok"][tokens] + params["pos"][positions]).astype(cd)
+        h = self._embed(params, tokens, positions)
+        rope = self._rope_for(positions)
 
         def block(h, lp):
             h, aux, _, _ = self._block_fwd(
                 h, lp,
                 lambda q, k, v: self._attend(q, k, v, attn, seq_axis),
-                attn, seq_axis,
+                attn, seq_axis, rope=rope,
             )
             return h, aux
 
@@ -165,13 +197,33 @@ class TransformerLM:
                         params["lnf_b"])
         return h @ params["head"], jnp.sum(auxes)
 
+    def _embed(self, params, tokens, positions):
+        """Token (+ learned-position) embedding in the compute dtype."""
+        h = params["tok"][tokens]
+        if self.pos_encoding == "learned":
+            h = h + params["pos"][positions]
+        return h.astype(self.compute_dtype)
+
+    def _rope_for(self, positions):
+        """Layer-invariant RoPE angles for ``positions`` ``[B, T]`` →
+        ``(cos, sin)`` shaped ``[B, T, 1, Dh/2]``, or ``None`` for learned
+        positions — computed ONCE per forward, outside the layer scan."""
+        if self.pos_encoding != "rotary":
+            return None
+        cos, sin = _rope_angles(positions, self.d_model // self.n_heads)
+        return cos[:, :, None, :], sin[:, :, None, :]
+
     def _block_fwd(self, h, lp, attend, attn: str, seq_axis: str,
-                   ep_groups: Optional[int] = None):
+                   ep_groups: Optional[int] = None, rope=None):
         """One transformer block on ``h`` ``[B, T, D]`` — THE single source
         of the block math (scanned over the stacked ``[L, ...]`` params by
         the teacher-forced forward and by ``prefill``, which also needs the
         per-layer K/V). Weight matrices cast to the compute dtype at use;
-        layernorm runs in f32. Returns ``(h_new, aux, k, v)``."""
+        layernorm runs in f32; under ``pos_encoding="rotary"`` the q/k head
+        vectors rotate by ``rope`` (from :meth:`_rope_for` — angles of the
+        ABSOLUTE positions, so sequence sharding needs nothing extra, and
+        the cached K are stored pre-rotated). Returns
+        ``(h_new, aux, k, v)``."""
         B, T = h.shape[0], h.shape[1]
         H = self.n_heads
         Dh = self.d_model // H
@@ -182,6 +234,9 @@ class TransformerLM:
         q = (x @ lp["wq"].astype(cd)).reshape(B, T, H, Dh)
         k = (x @ lp["wk"].astype(cd)).reshape(B, T, H, Dh)
         v = (x @ lp["wv"].astype(cd)).reshape(B, T, H, Dh)
+        if rope is not None:
+            q = _rope_rotate(q, *rope)
+            k = _rope_rotate(k, *rope)
         a = attend(q, k, v).astype(cd)
         h = h + a.reshape(B, T, self.d_model) @ lp["wo"].astype(cd)
         x = _layer_norm(
@@ -232,15 +287,16 @@ class TransformerLM:
         over ``tokens`` ``[B, T0]``, writing every position's K/V into
         ``cache`` at offset 0. Returns ``(logits [B, T0, V], cache)``."""
         B, T0 = tokens.shape
-        cd = self.compute_dtype
         positions = jnp.broadcast_to(jnp.arange(T0), (B, T0))
-        h = (params["tok"][tokens] + params["pos"][positions]).astype(cd)
+        h = self._embed(params, tokens, positions)
+
+        rope = self._rope_for(positions)
 
         def block(h, lp):
             h, _, k, v = self._block_fwd(
                 h, lp,
                 lambda q, k, v: attention_reference(q, k, v, causal=True),
-                "dense", SEQ_AXIS, ep_groups=1,
+                "dense", SEQ_AXIS, ep_groups=1, rope=rope,
             )
             return h, (k, v)
 
@@ -268,8 +324,12 @@ class TransformerLM:
         cd = self.compute_dtype
         scale = Dh ** -0.5
         cache_len = cache["k"].shape[2]
-        h = (params["tok"][token] + params["pos"][pos]).astype(cd)  # [B, D]
+        pos_b = jnp.broadcast_to(jnp.asarray(pos), (B,))
+        h = self._embed(params, token, pos_b)  # [B, D]
         pos_mask = (jnp.arange(cache_len) <= pos)[None, None, :]  # [1,1,T]
+        if self.pos_encoding == "rotary":
+            r_cos, r_sin = _rope_angles(pos_b, Dh)  # [B, Dh/2]
+            r_cos, r_sin = r_cos[:, None, :], r_sin[:, None, :]
 
         def block(h, inputs):
             lp, kc, vc = inputs  # layer params; cache slices [B, T, H, Dh]
@@ -279,6 +339,10 @@ class TransformerLM:
             q = (x @ lp["wq"].astype(cd)).reshape(B, H, Dh)
             k_new = (x @ lp["wk"].astype(cd)).reshape(B, 1, H, Dh)
             v_new = (x @ lp["wv"].astype(cd)).reshape(B, 1, H, Dh)
+            if self.pos_encoding == "rotary":
+                # cache stores PRE-ROTATED keys (prefill does the same)
+                q = _rope_rotate(q, r_cos, r_sin)
+                k_new = _rope_rotate(k_new, r_cos[:, None], r_sin[:, None])
             kc = jax.lax.dynamic_update_slice_in_dim(kc, k_new, pos, axis=1)
             vc = jax.lax.dynamic_update_slice_in_dim(vc, v_new, pos, axis=1)
             scores = jnp.einsum(
@@ -391,9 +455,10 @@ class MoETransformerLM(TransformerLM):
                  d_ff: int, max_len: int, n_experts: int, k: int = 2,
                  capacity_factor: float = 1.25, aux_weight: float = 1e-2,
                  ep_groups: int = 1, compute_dtype: str = "float32",
-                 routing: str = "token_choice"):
+                 routing: str = "token_choice", pos_encoding: str = "learned"):
         super().__init__(vocab, d_model, n_heads, n_layers, d_ff, max_len,
-                         compute_dtype=compute_dtype)
+                         compute_dtype=compute_dtype,
+                         pos_encoding=pos_encoding)
         from ..parallel.expert import MoEFeedForward
 
         if routing == "expert_choice":

@@ -23,10 +23,11 @@ def _model(**kw):
     return TransformerLM(**cfg)
 
 
-def test_decode_matches_teacher_forced_logits():
+@pytest.mark.parametrize("pos_encoding", ["learned", "rotary"])
+def test_decode_matches_teacher_forced_logits(pos_encoding):
     """Stepping the KV cache over a sequence must reproduce the full
-    forward's logits at every position."""
-    model = _model()
+    forward's logits at every position (both positional schemes)."""
+    model = _model(pos_encoding=pos_encoding)
     params = {k: jnp.asarray(v) for k, v in model.init(seed=1).items()}
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(rng.integers(0, 17, size=(2, 12)), jnp.int32)
@@ -43,11 +44,13 @@ def test_decode_matches_teacher_forced_logits():
     np.testing.assert_allclose(got, full, atol=3e-5, rtol=3e-5)
 
 
-@pytest.mark.parametrize("seed", [1, 2])
-def test_generate_matches_uncached_rollout(seed):
+@pytest.mark.parametrize("seed,pos_encoding", [(1, "learned"),
+                                               (2, "learned"),
+                                               (1, "rotary")])
+def test_generate_matches_uncached_rollout(seed, pos_encoding):
     """Greedy cached generation == growing the sequence via the full
     forward one argmax at a time (prompt preserved, continuation equal)."""
-    model = _model()
+    model = _model(pos_encoding=pos_encoding)
     params = {k: jnp.asarray(v) for k, v in model.init(seed=5).items()}
     rng = np.random.default_rng(seed)
     prompt = rng.integers(0, 17, size=(2, 4)).astype(np.int32)

@@ -119,6 +119,55 @@ def test_learns_synthetic_task():
     assert last < first * 0.5, (first, last)
 
 
+def test_rotary_forward_matches_dense_and_learns():
+    """RoPE: sharded ring forward equals the dense oracle (absolute
+    positions make rotation shard-invariant), params have no pos table,
+    and the LM still learns the synthetic task."""
+    model = TransformerLM(vocab=17, d_model=16, n_heads=4, n_layers=2,
+                          d_ff=32, max_len=32, pos_encoding="rotary")
+    assert "pos" not in model.param_shapes()
+    params = {k: jnp.asarray(v) for k, v in model.init(seed=1).items()}
+    tokens, positions, targets = _data()
+
+    want = np.asarray(model.apply(params, tokens, positions, attn="dense"))
+    mesh = build_mesh_sp(data=2, seq=4)
+    fwd = jax.jit(
+        jax.shard_map(
+            lambda p, tk, ps: model.apply(p, tk, ps, attn="ring"),
+            mesh=mesh,
+            in_specs=(model.specs(), P("data", "seq"), P("data", "seq")),
+            out_specs=P("data", "seq"),
+            check_vma=False,
+        )
+    )
+    sharding = NamedSharding(mesh, P("data", "seq"))
+    got = np.asarray(fwd(model.shard_params(mesh, model.init(seed=1)),
+                         jax.device_put(tokens, sharding),
+                         jax.device_put(positions, sharding)))
+    np.testing.assert_allclose(got, want, atol=5e-5, rtol=5e-5)
+
+    step, opt_init = build_lm_train_step(model, mesh, optax.adam(3e-3),
+                                         attn="ring")
+    p = model.shard_params(mesh, model.init(seed=0))
+    s = opt_init(p)
+    td, pd, gd = shard_lm_batch(mesh, *_data(b=8))
+    first = last = None
+    for i in range(30):
+        p, s, loss = step(p, s, td, pd, gd)
+        first = float(loss) if i == 0 else first
+        last = float(loss)
+    assert last < first * 0.5, (first, last)
+
+
+def test_pos_encoding_validation():
+    with pytest.raises(ValueError, match="pos_encoding"):
+        TransformerLM(vocab=10, d_model=16, n_heads=4, n_layers=1,
+                      d_ff=16, max_len=8, pos_encoding="alibi")
+    with pytest.raises(ValueError, match="even head dim"):
+        TransformerLM(vocab=10, d_model=12, n_heads=4, n_layers=1,
+                      d_ff=16, max_len=8, pos_encoding="rotary")
+
+
 def test_bfloat16_compute():
     """bf16 activations: forward stays close to f32, training still learns,
     params/optimizer remain f32."""
