@@ -5,6 +5,7 @@ from .transformer import (
     SEQ_AXIS,
     MoETransformerLM,
     TransformerLM,
+    build_lm_eval_step,
     build_lm_train_step,
     build_mesh_sp,
     make_lm_batches,
@@ -21,6 +22,7 @@ __all__ = [
     "MoETransformerLM",
     "build_mesh_sp",
     "build_lm_train_step",
+    "build_lm_eval_step",
     "make_lm_batches",
     "shard_lm_batch",
 ]

@@ -89,7 +89,7 @@ class TransformerLM:
 
     def __init__(self, vocab: int, d_model: int, n_heads: int, n_layers: int,
                  d_ff: int, max_len: int, compute_dtype: str = "float32",
-                 pos_encoding: str = "learned"):
+                 pos_encoding: str = "learned", tie_embeddings: bool = False):
         if d_model % n_heads:
             raise ValueError(f"d_model {d_model} not divisible by {n_heads} heads")
         if pos_encoding not in ("learned", "rotary"):
@@ -99,6 +99,7 @@ class TransformerLM:
                 f"rotary needs an even head dim, got {d_model // n_heads}"
             )
         self.pos_encoding = pos_encoding
+        self.tie_embeddings = bool(tie_embeddings)
         self.vocab = vocab
         self.d_model = d_model
         self.n_heads = n_heads
@@ -127,8 +128,9 @@ class TransformerLM:
             "w1": sds((L, D, F), f32), "b1": sds((L, F), f32),
             "w2": sds((L, F, D), f32), "b2": sds((L, D), f32),
             "lnf_s": sds((D,), f32), "lnf_b": sds((D,), f32),
-            "head": sds((D, V), f32),
         }
+        if not self.tie_embeddings:
+            shapes["head"] = sds((D, V), f32)
         if self.pos_encoding == "learned":
             shapes["pos"] = sds((T, D), f32)
         return shapes
@@ -195,7 +197,14 @@ class TransformerLM:
         )
         h = _layer_norm(h.astype(jnp.float32), params["lnf_s"],
                         params["lnf_b"])
-        return h @ params["head"], jnp.sum(auxes)
+        return self._logits(params, h), jnp.sum(auxes)
+
+    def _logits(self, params, h):
+        """Output projection: the ``head`` matrix, or the transposed token
+        embedding when ``tie_embeddings`` (Press & Wolf 2017 — halves the
+        embedding-side parameter count and often improves small LMs)."""
+        w = params["tok"].T if self.tie_embeddings else params["head"]
+        return h @ w
 
     def _embed(self, params, tokens, positions):
         """Token (+ learned-position) embedding in the compute dtype."""
@@ -308,7 +317,7 @@ class TransformerLM:
         }
         h = _layer_norm(h.astype(jnp.float32), params["lnf_s"],
                         params["lnf_b"])
-        return h @ params["head"], cache
+        return self._logits(params, h), cache
 
     def decode_step(self, params, token, pos, cache):
         """One cached decode step: ``token`` ``[B]`` int at absolute
@@ -370,7 +379,7 @@ class TransformerLM:
         )
         h = _layer_norm(h.astype(jnp.float32), params["lnf_s"],
                         params["lnf_b"])
-        return h @ params["head"], {"k": kc_new, "v": vc_new}
+        return self._logits(params, h), {"k": kc_new, "v": vc_new}
 
     def generate(self, params, prompt, n_new: int,
                  temperature: float = 0.0, top_k: Optional[int] = None,
@@ -455,10 +464,12 @@ class MoETransformerLM(TransformerLM):
                  d_ff: int, max_len: int, n_experts: int, k: int = 2,
                  capacity_factor: float = 1.25, aux_weight: float = 1e-2,
                  ep_groups: int = 1, compute_dtype: str = "float32",
-                 routing: str = "token_choice", pos_encoding: str = "learned"):
+                 routing: str = "token_choice", pos_encoding: str = "learned",
+                 tie_embeddings: bool = False):
         super().__init__(vocab, d_model, n_heads, n_layers, d_ff, max_len,
                          compute_dtype=compute_dtype,
-                         pos_encoding=pos_encoding)
+                         pos_encoding=pos_encoding,
+                         tie_embeddings=tie_embeddings)
         from ..parallel.expert import MoEFeedForward
 
         if routing == "expert_choice":
@@ -535,21 +546,9 @@ def make_lm_batches(token_rows: np.ndarray):
     return tokens.astype(np.int32), positions.copy(), targets.astype(np.int32)
 
 
-def build_lm_train_step(model: TransformerLM, mesh: Mesh, optimizer,
-                        attn: str = "ring"):
-    """Compile one dp×sp (×ep for the MoE variant) LM training step.
-
-    Returns ``(step, opt_init)``: ``step(params, opt_state, tokens,
-    positions, targets) -> (params, opt_state, loss)`` with all three int
-    arrays ``[B, T]`` — batch dim sharded over ``"data"``, sequence dim over
-    ``"seq"``. Params and optimizer state follow ``model.specs()``: fully
-    replicated for the dense model; for :class:`MoETransformerLM` the expert
-    stacks (and their optimizer state) shard over ``"seq"`` and their
-    gradients skip the seq-axis psum (each seq rank owns its experts — the
-    all_to_all transpose already delivered their gradients locally).
-    ``loss`` is the optimized objective: token-mean CE plus the
-    ``aux_weight``-scaled load-balancing term (zero for the dense model).
-    """
+def _validate_lm_step(model: TransformerLM, mesh: Mesh, attn: str) -> int:
+    """Shared build-time validation for the LM train/eval builders; returns
+    the seq-axis size."""
     sp = mesh.shape[SEQ_AXIS]
     if attn not in ("dense", "ring", "ulysses"):
         raise ValueError(f"Unknown attn: {attn}")
@@ -574,6 +573,39 @@ def build_lm_train_step(model: TransformerLM, mesh: Mesh, optimizer,
             f"n_experts {moe.n_experts} not divisible by seq axis size {sp} "
             "(experts shard over the sequence axis)"
         )
+    return sp
+
+
+def _check_seq_len(model: TransformerLM, sp: int, t: int) -> None:
+    """Call-time guard shared by the train/eval steps: JAX clamps
+    out-of-range gathers under jit, so an over-long sequence would silently
+    reuse the last positional-embedding row."""
+    if t > model.max_len:
+        raise ValueError(
+            f"sequence length {t} exceeds max_len {model.max_len}"
+        )
+    if t % sp:
+        raise ValueError(
+            f"sequence length {t} not divisible by seq axis size {sp}"
+        )
+
+
+def build_lm_train_step(model: TransformerLM, mesh: Mesh, optimizer,
+                        attn: str = "ring"):
+    """Compile one dp×sp (×ep for the MoE variant) LM training step.
+
+    Returns ``(step, opt_init)``: ``step(params, opt_state, tokens,
+    positions, targets) -> (params, opt_state, loss)`` with all three int
+    arrays ``[B, T]`` — batch dim sharded over ``"data"``, sequence dim over
+    ``"seq"``. Params and optimizer state follow ``model.specs()``: fully
+    replicated for the dense model; for :class:`MoETransformerLM` the expert
+    stacks (and their optimizer state) shard over ``"seq"`` and their
+    gradients skip the seq-axis psum (each seq rank owns its experts — the
+    all_to_all transpose already delivered their gradients locally).
+    ``loss`` is the optimized objective: token-mean CE plus the
+    ``aux_weight``-scaled load-balancing term (zero for the dense model).
+    """
+    sp = _validate_lm_step(model, mesh, attn)
     from ..parallel.param_utils import opt_state_specs
 
     pspecs = model.specs()
@@ -636,21 +668,44 @@ def build_lm_train_step(model: TransformerLM, mesh: Mesh, optimizer,
     )
 
     def step(params, opt_state, tokens, positions, targets):
-        t = tokens.shape[1]
-        # JAX clamps out-of-range gathers under jit, so an over-long
-        # sequence would silently reuse the last positional-embedding row —
-        # reject it here where shapes are visible.
-        if t > model.max_len:
-            raise ValueError(
-                f"sequence length {t} exceeds max_len {model.max_len}"
-            )
-        if t % sp:
-            raise ValueError(
-                f"sequence length {t} not divisible by seq axis size {sp}"
-            )
+        _check_seq_len(model, sp, tokens.shape[1])
         return jit_step(params, opt_state, tokens, positions, targets)
 
     return step, make_opt_init(optimizer, mesh, sspecs)
+
+
+def build_lm_eval_step(model: TransformerLM, mesh: Mesh, attn: str = "ring"):
+    """Compile a dp×sp evaluation step: ``eval_fn(params, tokens, positions,
+    targets) -> mean next-token cross-entropy`` (perplexity =
+    ``exp(result)``) over the same shardings the train step uses — batch
+    over ``"data"``, sequence over ``"seq"``. Same validation rules as
+    :func:`build_lm_train_step`."""
+    sp = _validate_lm_step(model, mesh, attn)
+    pspecs = model.specs()
+    tok_spec = P(DATA_AXIS, SEQ_AXIS)
+    dp = mesh.shape[DATA_AXIS]
+
+    def eval_impl(params, tokens, positions, targets):
+        ntok_total = float(tokens.shape[0] * tokens.shape[1] * dp * sp)
+        local = model.loss(params, tokens, positions, targets, attn=attn)
+        return jax.lax.psum(
+            jax.lax.psum(local, SEQ_AXIS), DATA_AXIS
+        ) / ntok_total
+
+    jit_eval = jax.jit(
+        jax.shard_map(
+            eval_impl, mesh=mesh,
+            in_specs=(pspecs, tok_spec, tok_spec, tok_spec),
+            out_specs=P(),
+            check_vma=False,
+        )
+    )
+
+    def eval_fn(params, tokens, positions, targets):
+        _check_seq_len(model, sp, tokens.shape[1])
+        return jit_eval(params, tokens, positions, targets)
+
+    return eval_fn
 
 
 def shard_lm_batch(mesh: Mesh, tokens, positions, targets):
