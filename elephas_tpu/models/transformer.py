@@ -89,9 +89,16 @@ class TransformerLM:
 
     def __init__(self, vocab: int, d_model: int, n_heads: int, n_layers: int,
                  d_ff: int, max_len: int, compute_dtype: str = "float32",
-                 pos_encoding: str = "learned", tie_embeddings: bool = False):
+                 pos_encoding: str = "learned", tie_embeddings: bool = False,
+                 n_kv_heads: Optional[int] = None):
         if d_model % n_heads:
             raise ValueError(f"d_model {d_model} not divisible by {n_heads} heads")
+        n_kv_heads = n_heads if n_kv_heads is None else int(n_kv_heads)
+        if n_kv_heads < 1 or n_heads % n_kv_heads:
+            raise ValueError(
+                f"n_heads {n_heads} not divisible by n_kv_heads {n_kv_heads}"
+            )
+        self.n_kv_heads = n_kv_heads
         if pos_encoding not in ("learned", "rotary"):
             raise ValueError(f"Unknown pos_encoding: {pos_encoding}")
         if pos_encoding == "rotary" and (d_model // n_heads) % 2:
@@ -122,8 +129,10 @@ class TransformerLM:
         shapes = {
             "tok": sds((V, D), f32),
             "ln1_s": sds((L, D), f32), "ln1_b": sds((L, D), f32),
-            "wq": sds((L, D, D), f32), "wk": sds((L, D, D), f32),
-            "wv": sds((L, D, D), f32), "wo": sds((L, D, D), f32),
+            "wq": sds((L, D, D), f32),
+            "wk": sds((L, D, (D // self.n_heads) * self.n_kv_heads), f32),
+            "wv": sds((L, D, (D // self.n_heads) * self.n_kv_heads), f32),
+            "wo": sds((L, D, D), f32),
             "ln2_s": sds((L, D), f32), "ln2_b": sds((L, D), f32),
             "w1": sds((L, D, F), f32), "b1": sds((L, F), f32),
             "w2": sds((L, F, D), f32), "b2": sds((L, D), f32),
@@ -231,22 +240,26 @@ class TransformerLM:
         layernorm runs in f32; under ``pos_encoding="rotary"`` the q/k head
         vectors rotate by ``rope`` (from :meth:`_rope_for` — angles of the
         ABSOLUTE positions, so sequence sharding needs nothing extra, and
-        the cached K are stored pre-rotated). Returns
+        the cached K are stored pre-rotated). Under grouped-query attention
+        (``n_kv_heads < n_heads``) the returned (cacheable) k/v carry only
+        the KV heads; they are repeated up to full heads for the attention
+        compute (rotation commutes with the repeat). Returns
         ``(h_new, aux, k, v)``."""
         B, T = h.shape[0], h.shape[1]
         H = self.n_heads
+        Hkv = self.n_kv_heads
         Dh = self.d_model // H
         cd = self.compute_dtype
         x = _layer_norm(
             h.astype(jnp.float32), lp["ln1_s"], lp["ln1_b"]
         ).astype(cd)
         q = (x @ lp["wq"].astype(cd)).reshape(B, T, H, Dh)
-        k = (x @ lp["wk"].astype(cd)).reshape(B, T, H, Dh)
-        v = (x @ lp["wv"].astype(cd)).reshape(B, T, H, Dh)
+        k = (x @ lp["wk"].astype(cd)).reshape(B, T, Hkv, Dh)
+        v = (x @ lp["wv"].astype(cd)).reshape(B, T, Hkv, Dh)
         if rope is not None:
             q = _rope_rotate(q, *rope)
             k = _rope_rotate(k, *rope)
-        a = attend(q, k, v).astype(cd)
+        a = attend(q, k, v).astype(cd)  # ops broadcast KV heads as needed
         h = h + a.reshape(B, T, self.d_model) @ lp["wo"].astype(cd)
         x = _layer_norm(
             h.astype(jnp.float32), lp["ln2_s"], lp["ln2_b"]
@@ -282,12 +295,14 @@ class TransformerLM:
 
     # -- autoregressive inference (KV cache) ----------------------------
     def init_cache(self, batch: int, length: Optional[int] = None) -> Dict[str, Any]:
-        """Zeroed KV cache ``{"k"/"v": [L, B, length, H, Dh]}`` (``length``
+        """Zeroed KV cache ``{"k"/"v": [L, B, length, Hkv, Dh]}`` (``length``
         defaults to ``max_len``; size it to the actual decode horizon —
-        every step attends over the whole cache)."""
-        L, H = self.n_layers, self.n_heads
+        every step attends over the whole cache). Under grouped-query
+        attention the cache holds only the KV heads: memory scales down by
+        ``n_heads / n_kv_heads``."""
+        L = self.n_layers
         T = self.max_len if length is None else int(length)
-        shape = (L, batch, T, H, self.d_model // H)
+        shape = (L, batch, T, self.n_kv_heads, self.d_model // self.n_heads)
         z = jnp.zeros(shape, self.compute_dtype)
         return {"k": z, "v": z}
 
@@ -310,7 +325,7 @@ class TransformerLM:
             return h, (k, v)
 
         lps = {k: params[k] for k in self._block_keys()}
-        h, (ks, vs) = jax.lax.scan(block, h, lps)  # ks/vs [L, B, T0, H, Dh]
+        h, (ks, vs) = jax.lax.scan(block, h, lps)  # ks/vs [L, B, T0, Hkv, Dh]
         cache = {
             "k": jax.lax.dynamic_update_slice_in_dim(cache["k"], ks, 0, axis=2),
             "v": jax.lax.dynamic_update_slice_in_dim(cache["v"], vs, 0, axis=2),
@@ -329,6 +344,7 @@ class TransformerLM:
         intentionally differs from teacher-forced whole-block routing."""
         B = token.shape[0]
         H = self.n_heads
+        Hkv = self.n_kv_heads
         Dh = self.d_model // H
         cd = self.compute_dtype
         scale = Dh ** -0.5
@@ -341,30 +357,35 @@ class TransformerLM:
             r_cos, r_sin = r_cos[:, None, :], r_sin[:, None, :]
 
         def block(h, inputs):
-            lp, kc, vc = inputs  # layer params; cache slices [B, T, H, Dh]
+            lp, kc, vc = inputs  # layer params; cache slices [B, T, Hkv, Dh]
             x = _layer_norm(
                 h.astype(jnp.float32), lp["ln1_s"], lp["ln1_b"]
             ).astype(cd)
             q = (x @ lp["wq"].astype(cd)).reshape(B, H, Dh)
-            k_new = (x @ lp["wk"].astype(cd)).reshape(B, 1, H, Dh)
-            v_new = (x @ lp["wv"].astype(cd)).reshape(B, 1, H, Dh)
+            k_new = (x @ lp["wk"].astype(cd)).reshape(B, 1, Hkv, Dh)
+            v_new = (x @ lp["wv"].astype(cd)).reshape(B, 1, Hkv, Dh)
             if self.pos_encoding == "rotary":
                 # cache stores PRE-ROTATED keys (prefill does the same)
                 q = _rope_rotate(q, r_cos, r_sin)
                 k_new = _rope_rotate(k_new, r_cos[:, None], r_sin[:, None])
             kc = jax.lax.dynamic_update_slice_in_dim(kc, k_new, pos, axis=1)
             vc = jax.lax.dynamic_update_slice_in_dim(vc, v_new, pos, axis=1)
+            # grouped einsum straight against the Hkv-head cache: no
+            # expanded copy (query head h = kv_head·G + g, matching the
+            # repeat layout the training paths broadcast to)
+            qg = q.reshape(B, Hkv, H // Hkv, Dh)
             scores = jnp.einsum(
-                "bhd,bthd->bht", q, kc, preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST,
-            ) * scale
-            scores = jnp.where(pos_mask, scores, -jnp.inf)
-            probs = jax.nn.softmax(scores, axis=-1)
-            a = jnp.einsum(
-                "bht,bthd->bhd", probs, vc,
+                "bkgd,btkd->bkgt", qg, kc,
                 preferred_element_type=jnp.float32,
                 precision=jax.lax.Precision.HIGHEST,
-            ).astype(cd)
+            ) * scale
+            scores = jnp.where(pos_mask[:, :, None, :], scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1)
+            a = jnp.einsum(
+                "bkgt,btkd->bkgd", probs, vc,
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            ).astype(cd).reshape(B, H, Dh)
             h = h + a.reshape(B, self.d_model) @ lp["wo"].astype(cd)
             x = _layer_norm(
                 h.astype(jnp.float32), lp["ln2_s"], lp["ln2_b"]
@@ -465,11 +486,13 @@ class MoETransformerLM(TransformerLM):
                  capacity_factor: float = 1.25, aux_weight: float = 1e-2,
                  ep_groups: int = 1, compute_dtype: str = "float32",
                  routing: str = "token_choice", pos_encoding: str = "learned",
-                 tie_embeddings: bool = False):
+                 tie_embeddings: bool = False,
+                 n_kv_heads: Optional[int] = None):
         super().__init__(vocab, d_model, n_heads, n_layers, d_ff, max_len,
                          compute_dtype=compute_dtype,
                          pos_encoding=pos_encoding,
-                         tie_embeddings=tie_embeddings)
+                         tie_embeddings=tie_embeddings,
+                         n_kv_heads=n_kv_heads)
         from ..parallel.expert import MoEFeedForward
 
         if routing == "expert_choice":

@@ -43,6 +43,22 @@ import jax
 import jax.numpy as jnp
 
 
+def repeat_kv_heads(x, n_heads: int, axis: int = -2):
+    """Grouped-query attention support: broadcast ``Hkv`` KV heads up to
+    ``n_heads`` along ``axis`` (identity when equal). Every attention entry
+    point accepts K/V with a divisor head count and repeats at the LATEST
+    possible point, so the ring's ppermute hops and Ulysses' all_to_alls
+    carry only the small KV heads."""
+    hkv = x.shape[axis]
+    if hkv == n_heads:
+        return x
+    if n_heads % hkv:
+        raise ValueError(
+            f"KV head count {hkv} must divide query head count {n_heads}"
+        )
+    return jnp.repeat(x, n_heads // hkv, axis=axis)
+
+
 def _pick_block(t: int, block_size: int) -> int:
     """Largest divisor of ``t`` not exceeding ``block_size`` (t prime → 1:
     correct, just slow — callers control T)."""
@@ -201,9 +217,13 @@ def flash_attention(q, k, v, causal: bool = False, block_size: int = 128):
     """Exact attention via online softmax over KV blocks, ``O(T · block)``
     memory in BOTH directions (see module docstring).
 
-    ``q``/``k``/``v``: ``[B, T, H, D]``; any ``T`` works (the block size
-    falls back to the largest divisor ≤ ``block_size``). Equals
+    ``q``: ``[B, T, H, D]``; ``k``/``v`` may carry fewer (divisor) KV
+    heads — grouped-query attention, broadcast here (local compute; the
+    comm-level saving lives in the callers). Any ``T`` works (the block
+    size falls back to the largest divisor ≤ ``block_size``). Equals
     :func:`~elephas_tpu.ops.ring_attention.attention_reference` to float32
     accumulation, gradients included.
     """
+    k = repeat_kv_heads(k, q.shape[2])
+    v = repeat_kv_heads(v, q.shape[2])
     return _flash(q, k, v, causal, block_size)

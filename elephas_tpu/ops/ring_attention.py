@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from ..parallel.mesh import DATA_AXIS
-from .flash_attention import fold_softmax_block
+from .flash_attention import fold_softmax_block, repeat_kv_heads
 
 
 def attention_reference(q, k, v, causal: bool = False):
@@ -38,12 +38,15 @@ def attention_reference(q, k, v, causal: bool = False):
     local body uses blockwise ``flash_attention`` instead, avoiding this
     function's ``[T, T]`` score matrix).
 
-    ``q``/``k``/``v``: ``[B, T, H, D]``. Returns ``[B, T, H, D]`` in the
-    input dtype. Scores, softmax, and the value sum accumulate in float32
-    even for bf16 inputs — summing a long sequence's normalizer in an
-    8-bit mantissa loses exactly the precision flash/ring practice warns
+    ``q``: ``[B, T, H, D]``; ``k``/``v``: ``[B, T, H, D]`` or fewer
+    (divisor) KV heads — grouped-query attention. Returns ``[B, T, H, D]``
+    in the input dtype. Scores, softmax, and the value sum accumulate in
+    float32 even for bf16 inputs — summing a long sequence's normalizer in
+    an 8-bit mantissa loses exactly the precision flash/ring practice warns
     about, so every attention path in the package shares the f32 rule.
     """
+    k = repeat_kv_heads(k, q.shape[2])
+    v = repeat_kv_heads(v, q.shape[2])
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32,
@@ -62,8 +65,10 @@ def attention_reference(q, k, v, causal: bool = False):
 
 
 def _ring_attention_local(q, k, v, causal: bool, axis_name: str):
-    """Per-shard body: runs INSIDE shard_map. ``q``/``k``/``v`` are the local
-    sequence blocks ``[B, Tb, H, D]``."""
+    """Per-shard body: runs INSIDE shard_map. ``q``: local sequence block
+    ``[B, Tb, H, D]``; ``k``/``v`` may carry fewer (divisor) KV heads —
+    the ring's ppermute hops then move only the small blocks, and heads
+    broadcast at the local score compute."""
     p = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     b, tq, h, d = q.shape
@@ -78,16 +83,16 @@ def _ring_attention_local(q, k, v, causal: bool, axis_name: str):
         ``flash_attention.fold_softmax_block``)."""
         src = (rank - j) % p
         scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q, kb, preferred_element_type=jnp.float32,
-        precision=jax.lax.Precision.HIGHEST
+            "bqhd,bkhd->bhqk", q, repeat_kv_heads(kb, h),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST
         ) * scale
         if causal:
             kpos = src * tk + jnp.arange(tk)
             mask = kpos[None, :] <= qpos[:, None]  # [Tq, Tk]
             scores = jnp.where(mask[None, None], scores, -jnp.inf)
-        return fold_softmax_block(
-            scores, jnp.transpose(vb, (0, 2, 1, 3)), m, l, acc
-        )
+        vb_full = jnp.transpose(repeat_kv_heads(vb, h), (0, 2, 1, 3))
+        return fold_softmax_block(scores, vb_full, m, l, acc)
 
     def step(j, carry):
         m, l, acc, kb, vb = carry

@@ -28,13 +28,21 @@ from functools import partial
 import jax
 
 from ..parallel.mesh import DATA_AXIS
-from .flash_attention import flash_attention
+from .flash_attention import flash_attention, repeat_kv_heads
 from .ring_attention import sharded_seq_attention
 
 
 def _ulysses_local(q, k, v, causal: bool, axis_name: str):
-    """Per-shard body INSIDE shard_map. ``q``/``k``/``v``: local sequence
-    blocks ``[B, T/P, H, D]`` → out ``[B, T/P, H, D]``."""
+    """Per-shard body INSIDE shard_map. ``q``: local sequence block
+    ``[B, T/P, H, D]`` → out ``[B, T/P, H, D]``. ``k``/``v`` may carry
+    fewer (divisor) KV heads: when the KV head count still divides the
+    group size, the all_to_alls move only the small blocks and flash
+    broadcasts locally; otherwise heads broadcast before the re-shard."""
+    p = jax.lax.axis_size(axis_name)
+    h = q.shape[2]
+    if k.shape[2] % p:
+        k = repeat_kv_heads(k, h)
+        v = repeat_kv_heads(v, h)
     # seq-sharded/head-full → seq-full/head-sharded: [B, T, H/P, D]
     a2a = partial(
         jax.lax.all_to_all, axis_name=axis_name, split_axis=2,
@@ -42,7 +50,8 @@ def _ulysses_local(q, k, v, causal: bool, axis_name: str):
     )
     qh, kh, vh = a2a(q), a2a(k), a2a(v)
     # full sequence per head group here — blockwise flash keeps the local
-    # attention O(T·block) instead of materializing [T, T]
+    # attention O(T·block) instead of materializing [T, T] (and finishes
+    # any remaining KV-head broadcast)
     out = flash_attention(qh, kh, vh, causal=causal)
     # seq-full/head-sharded → seq-sharded/head-full
     return jax.lax.all_to_all(

@@ -208,6 +208,68 @@ def test_tied_embeddings_and_eval_step():
     np.testing.assert_array_equal(out, seq)
 
 
+@pytest.mark.parametrize("n_kv,pos_enc", [(2, "learned"), (1, "rotary")])
+def test_gqa_matches_dense_and_shrinks_cache(n_kv, pos_enc):
+    """Grouped-query attention: sharded ring forward equals the dense
+    oracle, the KV cache carries only the KV heads, decode stays exact,
+    and training still learns."""
+    model = TransformerLM(vocab=17, d_model=16, n_heads=4, n_layers=2,
+                          d_ff=32, max_len=32, n_kv_heads=n_kv,
+                          pos_encoding=pos_enc)
+    assert model.param_shapes()["wk"].shape == (2, 16, 4 * n_kv)
+    params = {k: jnp.asarray(v) for k, v in model.init(seed=1).items()}
+    tokens, positions, targets = _data()
+
+    want = np.asarray(model.apply(params, tokens, positions, attn="dense"))
+    mesh = build_mesh_sp(data=2, seq=4)
+    fwd = jax.jit(
+        jax.shard_map(
+            lambda p, tk, ps: model.apply(p, tk, ps, attn="ring"),
+            mesh=mesh,
+            in_specs=(model.specs(), P("data", "seq"), P("data", "seq")),
+            out_specs=P("data", "seq"),
+            check_vma=False,
+        )
+    )
+    sharding = NamedSharding(mesh, P("data", "seq"))
+    got = np.asarray(fwd(model.shard_params(mesh, model.init(seed=1)),
+                         jax.device_put(tokens, sharding),
+                         jax.device_put(positions, sharding)))
+    np.testing.assert_allclose(got, want, atol=5e-5, rtol=5e-5)
+
+    # cache holds only the KV heads; cached decode still equals the full
+    # forward's logits position-by-position
+    cache = model.init_cache(batch=tokens.shape[0], length=12)
+    assert cache["k"].shape == (2, tokens.shape[0], 12, n_kv, 4)
+    toks12 = jnp.asarray(tokens[:, :12])
+    full = np.asarray(model.apply(params, toks12, positions[:, :12],
+                                  attn="dense"))
+    step_logits = []
+    for t in range(12):
+        logits, cache = model.decode_step(params, toks12[:, t], t, cache)
+        step_logits.append(np.asarray(logits))
+    np.testing.assert_allclose(np.stack(step_logits, 1), full,
+                               atol=3e-5, rtol=3e-5)
+
+    step, opt_init = build_lm_train_step(model, mesh, optax.adam(3e-3),
+                                         attn="ring")
+    p = model.shard_params(mesh, model.init(seed=0))
+    s = opt_init(p)
+    td, pd, gd = shard_lm_batch(mesh, *_data(b=8))
+    first = last = None
+    for i in range(30):
+        p, s, loss = step(p, s, td, pd, gd)
+        first = float(loss) if i == 0 else first
+        last = float(loss)
+    assert last < first * 0.6, (first, last)
+
+
+def test_gqa_validation():
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        TransformerLM(vocab=10, d_model=16, n_heads=4, n_layers=1,
+                      d_ff=16, max_len=8, n_kv_heads=3)
+
+
 def test_pos_encoding_validation():
     with pytest.raises(ValueError, match="pos_encoding"):
         TransformerLM(vocab=10, d_model=16, n_heads=4, n_layers=1,

@@ -63,3 +63,19 @@ def test_bf16_accumulates_f32():
     np.testing.assert_allclose(
         np.asarray(out, np.float32), ref, atol=5e-2, rtol=5e-2
     )
+
+
+def test_gqa_kv_heads_match_repeated_oracle():
+    """K/V with fewer (divisor) heads equal explicit repetition."""
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.normal(size=(2, 32, 8, 8)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(2, 32, 2, 8)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(2, 32, 2, 8)), jnp.float32)
+    kf = jnp.repeat(k, 4, axis=2)
+    vf = jnp.repeat(v, 4, axis=2)
+    got = np.asarray(flash_attention(q, k, v, causal=True, block_size=8))
+    want = np.asarray(attention_reference(q, kf, vf, causal=True))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+    with pytest.raises(ValueError, match="divide"):
+        flash_attention(q, k[:, :, :1].repeat(3, axis=2), v, causal=True)

@@ -55,3 +55,20 @@ def test_gradients_flow(causal):
     g_ring = np.asarray(jax.grad(loss_ring)(jnp.asarray(q)))
     g_ref = np.asarray(jax.grad(loss_ref)(jnp.asarray(q)))
     np.testing.assert_allclose(g_ring, g_ref, atol=2e-4, rtol=2e-4)
+
+
+def test_gqa_small_kv_rides_the_ring():
+    """Ring attention accepts divisor KV heads (the ppermute hops then move
+    only the small blocks) and equals the repeated-KV oracle."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(4)
+    q = rng.normal(size=(2, 64, 8, 8)).astype("float32")
+    k = rng.normal(size=(2, 64, 2, 8)).astype("float32")
+    v = rng.normal(size=(2, 64, 2, 8)).astype("float32")
+    mesh = build_mesh(8)
+    got = np.asarray(ring_attention(q, k, v, mesh=mesh, causal=True))
+    want = np.asarray(attention_reference(
+        q, np.repeat(k, 4, axis=2), np.repeat(v, 4, axis=2), causal=True
+    ))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)

@@ -72,3 +72,25 @@ def test_agrees_with_ring():
     a = np.asarray(ulysses_attention(q, k, v, mesh=mesh, causal=True))
     b = np.asarray(ring_attention(q, k, v, mesh=mesh, causal=True))
     np.testing.assert_allclose(a, b, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("hkv", [2, 8, 3])
+def test_gqa_kv_heads(hkv):
+    """Ulysses accepts divisor KV heads (Hkv % P == 0 re-shards the small
+    blocks; otherwise they broadcast before the all_to_all); Hkv=3 does
+    not divide H=8 and must be rejected."""
+    rng = np.random.default_rng(5)
+    q = rng.normal(size=(2, 64, 8, 8)).astype("float32")
+    k = rng.normal(size=(2, 64, hkv, 8)).astype("float32")
+    v = rng.normal(size=(2, 64, hkv, 8)).astype("float32")
+    mesh = build_mesh(8)
+    if 8 % hkv:
+        with pytest.raises(Exception):
+            np.asarray(ulysses_attention(q, k, v, mesh=mesh, causal=True))
+        return
+    got = np.asarray(ulysses_attention(q, k, v, mesh=mesh, causal=True))
+    want = np.asarray(attention_reference(
+        q, np.repeat(k, 8 // hkv, axis=2), np.repeat(v, 8 // hkv, axis=2),
+        causal=True,
+    ))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
