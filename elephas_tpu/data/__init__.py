@@ -1,12 +1,21 @@
 from .dataframe import DataFrame, Row, SparkSession
 from .native_loader import NativeBatchLoader
-from .rdd import RDD, Broadcast, SparkConf, SparkContext
+from .rdd import (
+    RDD,
+    Broadcast,
+    SparkConf,
+    SparkContext,
+    TaskContext,
+    TaskFailedError,
+)
 
 __all__ = [
     "RDD",
     "Broadcast",
     "SparkConf",
     "SparkContext",
+    "TaskContext",
+    "TaskFailedError",
     "DataFrame",
     "Row",
     "SparkSession",

@@ -34,8 +34,76 @@ from __future__ import annotations
 
 import itertools
 import re
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Iterable, Iterator, List, Optional, Sequence
+
+
+class TaskContext:
+    """Per-task execution context, ``pyspark.TaskContext``-shaped.
+
+    Spark exposes the running task's identity to executor code via
+    ``TaskContext.get()``; the reference never reads it, but its async workers
+    *should have* (SURVEY.md §5.3 documents the retry non-idempotence hole this
+    enables fixing). Set by :meth:`RDD.mapPartitions` around each partition
+    function call, on the calling thread; ``get()`` returns ``None`` on the
+    driver, exactly like pyspark.
+    """
+
+    _local = threading.local()
+
+    def __init__(self, partition_id: int, attempt_number: int, stage_id: int):
+        self._partition_id = int(partition_id)
+        self._attempt_number = int(attempt_number)
+        self._stage_id = int(stage_id)
+
+    @classmethod
+    def get(cls) -> Optional["TaskContext"]:
+        return getattr(cls._local, "ctx", None)
+
+    def partitionId(self) -> int:
+        return self._partition_id
+
+    def attemptNumber(self) -> int:
+        """0 for the first attempt, incremented per retry (pyspark semantics)."""
+        return self._attempt_number
+
+    def stageId(self) -> int:
+        return self._stage_id
+
+    def taskAttemptId(self) -> int:
+        """Unique-per-(stage, partition, attempt) id, Spark-style.
+
+        40/24-bit fields: unique for partition counts < 2**24 and attempt
+        counts < 2**16 (Python ints don't overflow above that; collisions
+        would need a quinticillion-partition RDD).
+        """
+        return (
+            (self._stage_id << 40)
+            | (self._partition_id << 16)
+            | self._attempt_number
+        )
+
+    @classmethod
+    def _set(cls, ctx: Optional["TaskContext"]) -> None:
+        cls._local.ctx = ctx
+
+
+class TaskFailedError(RuntimeError):
+    """A partition function exhausted ``spark.task.maxFailures`` attempts.
+
+    Mirrors Spark's "Task failed N times; aborting job" stage failure — the
+    L0 behavior the reference inherits (SURVEY.md §5.3).
+    """
+
+    def __init__(self, partition_id: int, attempts: int, cause: BaseException):
+        super().__init__(
+            f"Task over partition {partition_id} failed {attempts} times; "
+            f"aborting job. Most recent failure: {cause!r}"
+        )
+        self.partition_id = partition_id
+        self.attempts = attempts
+        self.cause = cause
 
 
 class Broadcast:
@@ -116,15 +184,40 @@ class RDD:
         Concurrency across partitions mirrors Spark ``local[N]`` task slots —
         required for asynchronous/hogwild parameter-server semantics where
         workers genuinely interleave (reference ``elephas/worker.py:~60``).
+
+        Each partition call is a *task*: it runs under a :class:`TaskContext`
+        and is retried up to ``spark.task.maxFailures`` attempts (Spark
+        default 4) on exception, matching the Spark task-retry behavior the
+        reference inherits from L0 (SURVEY.md §5.3). After the last attempt
+        the job aborts with :class:`TaskFailedError`.
         """
+        max_failures = self._context.maxTaskFailures
+        stage_id = self._context._next_stage_id()
+
+        def run_task(args):
+            pid, part = args
+            last_err: Optional[BaseException] = None
+            for attempt in range(max_failures):
+                # Restore (not clear) on exit: a partition function may itself
+                # run a nested local mapPartitions on this thread and must get
+                # its own TaskContext back afterwards.
+                outer_ctx = TaskContext.get()
+                TaskContext._set(TaskContext(pid, attempt, stage_id))
+                try:
+                    return list(f(iter(part)))
+                except Exception as err:  # noqa: BLE001 — task isolation
+                    last_err = err
+                finally:
+                    TaskContext._set(outer_ctx)
+            raise TaskFailedError(pid, max_failures, last_err)
+
+        indexed = list(enumerate(self._partitions))
         n_threads = self._context.defaultParallelism
         if n_threads > 1 and len(self._partitions) > 1:
             with ThreadPoolExecutor(max_workers=n_threads) as pool:
-                results = list(
-                    pool.map(lambda p: list(f(iter(p))), self._partitions)
-                )
+                results = list(pool.map(run_task, indexed))
         else:
-            results = [list(f(iter(p))) for p in self._partitions]
+            results = [run_task(a) for a in indexed]
         return RDD(results, self._context)
 
     def repartition(self, num_partitions: int) -> "RDD":
@@ -197,6 +290,10 @@ class SparkContext:
         if conf is not None:
             master = conf.get("spark.master", master)
             appName = conf.get("spark.app.name", appName)
+        self._conf = conf if conf is not None else SparkConf()
+        # Spark's spark.task.maxFailures default is 4 = total attempts per task.
+        self.maxTaskFailures = int(self._conf.get("spark.task.maxFailures", 4))
+        self._stage_counter = itertools.count()
         self.master = master or "local[4]"
         self.appName = appName
         self._stopped = False
@@ -223,6 +320,12 @@ class SparkContext:
 
     def broadcast(self, value) -> Broadcast:
         return Broadcast(value)
+
+    def getConf(self) -> "SparkConf":
+        return self._conf
+
+    def _next_stage_id(self) -> int:
+        return next(self._stage_counter)
 
     def stop(self) -> None:
         self._stopped = True

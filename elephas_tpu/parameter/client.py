@@ -12,6 +12,7 @@ from __future__ import annotations
 import pickle
 import socket
 import threading
+import urllib.error
 import urllib.request
 from typing import List, Optional
 
@@ -39,6 +40,24 @@ class BaseParameterClient:
     def update_parameters(self, delta: List[np.ndarray]) -> None:
         raise NotImplementedError
 
+    def register_attempt(self, task_id: str, attempt: int) -> bool:
+        """Announce a task attempt to the server (exactly-once retry support).
+
+        Returns True if the server acknowledged the attempt API — callers
+        should then push with :meth:`update_parameters_tagged`. The default
+        (and any client without the extension, e.g. the native binary
+        protocol) returns False: pushes stay untagged and retry semantics
+        degrade to the reference's (documented) at-least-once behavior.
+        """
+        return False
+
+    def update_parameters_tagged(self, task_id: str,
+                                 delta: List[np.ndarray]) -> None:
+        self.update_parameters(delta)
+
+    def commit_attempt(self, task_id: str) -> None:
+        """Tell the server the task finished cleanly (frees its accumulator)."""
+
     def close(self) -> None:
         pass
 
@@ -58,12 +77,52 @@ class HttpClient(BaseParameterClient):
         ) as resp:
             return pickle.loads(resp.read())
 
-    def update_parameters(self, delta: List[np.ndarray]) -> None:
+    def update_parameters(self, delta: List[np.ndarray],
+                          _extra_headers: Optional[dict] = None) -> None:
         payload = pickle.dumps(delta, protocol=pickle.HIGHEST_PROTOCOL)
+        headers = {"Content-Type": "application/octet-stream"}
+        headers.update(_extra_headers or {})
         req = urllib.request.Request(
             f"http://{self.master_url}/update",
             data=payload,
-            headers={"Content-Type": "application/octet-stream"},
+            headers=headers,
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            resp.read()
+
+    def register_attempt(self, task_id: str, attempt: int) -> bool:
+        req = urllib.request.Request(
+            f"http://{self.master_url}/register",
+            data=b"",
+            headers={"X-Elephas-Task": task_id,
+                     "X-Elephas-Attempt": str(int(attempt))},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                resp.read()
+            return True
+        except urllib.error.HTTPError as err:
+            if err.code == 404:
+                # A reference-shaped server has no /register route: degrade
+                # to untagged at-least-once pushes.
+                return False
+            # Anything else (500/503/...) is a transient server fault, NOT
+            # "no attempt API" — the server may have registered the attempt,
+            # so degrading here would silently reopen the double-apply hole.
+            # Surface it; the task-retry machinery handles it.
+            raise
+
+    def update_parameters_tagged(self, task_id: str,
+                                 delta: List[np.ndarray]) -> None:
+        self.update_parameters(delta, _extra_headers={"X-Elephas-Task": task_id})
+
+    def commit_attempt(self, task_id: str) -> None:
+        req = urllib.request.Request(
+            f"http://{self.master_url}/commit",
+            data=b"",
+            headers={"X-Elephas-Task": task_id},
             method="POST",
         )
         with urllib.request.urlopen(req, timeout=60) as resp:
@@ -101,6 +160,46 @@ class SocketClient(BaseParameterClient):
             sock = self._ensure()
             sock.sendall(b"u")
             socket_utils.send(sock, delta)
+
+    def register_attempt(self, task_id: str, attempt: int) -> bool:
+        with self._lock:
+            sock = self._ensure()
+            try:
+                sock.sendall(b"r")
+                socket_utils.send(sock, (task_id, int(attempt)))
+                ack = sock.recv(1)
+            except socket.timeout:
+                # Slow server ≠ missing attempt API: it may have registered
+                # the attempt, so degrading to untagged pushes here would
+                # reopen the double-apply hole. Let task retry handle it.
+                raise
+            except ConnectionError:
+                # Server dropped the connection on the unknown opcode — the
+                # reference protocol's reaction. Treat as "no attempt API".
+                ack = b""
+            if ack != b"k":
+                # No-attempt-API server closed the connection (clean EOF or
+                # reset) — drop the dead socket so later plain pulls/pushes
+                # reconnect, and degrade to untagged pushes.
+                try:
+                    sock.close()
+                finally:
+                    self._sock = None
+                return False
+        return True
+
+    def update_parameters_tagged(self, task_id: str,
+                                 delta: List[np.ndarray]) -> None:
+        with self._lock:
+            sock = self._ensure()
+            sock.sendall(b"t")
+            socket_utils.send(sock, (task_id, delta))
+
+    def commit_attempt(self, task_id: str) -> None:
+        with self._lock:
+            sock = self._ensure()
+            sock.sendall(b"c")
+            socket_utils.send(sock, task_id)
 
     def close(self) -> None:
         with self._lock:

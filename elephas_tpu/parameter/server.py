@@ -50,16 +50,77 @@ class BaseParameterServer:
         self.port = int(port)
         self.lock = threading.Lock()
         self._running = False
+        # task_id -> {"attempt": int, "delta": accumulated delta or None}.
+        # Supports exactly-once retry semantics: see register_attempt.
+        self._attempts: dict = {}
 
     # -- weight ops ------------------------------------------------------
-    def apply_delta(self, delta: List[np.ndarray]) -> None:
+    def apply_delta(self, delta: List[np.ndarray],
+                    task_id: Optional[str] = None) -> None:
+        def _apply():
+            self.weights = subtract_params_np(self.weights, delta)
+            if task_id is not None and task_id in self._attempts:
+                acc = self._attempts[task_id]["delta"]
+                self._attempts[task_id]["delta"] = (
+                    [np.array(d) for d in delta] if acc is None
+                    else [a + d for a, d in zip(acc, delta)]
+                )
+
         if self.mode == "hogwild":
             # Lock-free by design: concurrent updates may interleave
-            # per-array — HOGWILD! semantics.
-            self.weights = subtract_params_np(self.weights, delta)
+            # per-array — HOGWILD! semantics. (Attempt accumulation shares
+            # that best-effort contract.)
+            _apply()
         else:
             with self.lock:
-                self.weights = subtract_params_np(self.weights, delta)
+                _apply()
+
+    def register_attempt(self, task_id: str, attempt: int) -> None:
+        """Announce that ``(task_id, attempt)`` is starting.
+
+        Fixes the reference's documented design hole (SURVEY.md §5.3): its
+        async path is not idempotent under Spark task retry — a retried task
+        re-pushes deltas on top of the ones its failed attempt already
+        applied. Here every tagged update is accumulated per task; when a
+        *newer* attempt of the same task registers, the failed attempt's whole
+        accumulated contribution is rolled back (weights += accumulated delta,
+        the inverse of the ``weights -= delta`` update rule) before the retry
+        pushes anything, restoring exactly-once per task. A stale or duplicate
+        register (attempt <= the live one, e.g. a zombie executor's replay) is
+        ignored — it must not undo the live attempt's work; any pushes the
+        zombie still makes accumulate under the live record, so a later retry
+        rolls them back with it. Registration is control-plane and always
+        takes the lock, even under hogwild.
+
+        Scope: the exactly-once guarantee holds for the LOCKED update modes
+        (``asynchronous``). Under ``hogwild`` pushes bypass the lock by
+        design, so a concurrent unlocked push can interleave with (and clobber
+        part of) the rollback's weight write — rollback there is best-effort,
+        exactly like every other hogwild write. That is the mode's contract:
+        it trades consistency for lock-free throughput.
+        """
+        with self.lock:
+            prev = self._attempts.get(task_id)
+            if prev is None:
+                self._attempts[task_id] = {"attempt": int(attempt), "delta": None}
+            elif int(attempt) > prev["attempt"]:
+                if prev["delta"] is not None:
+                    self.weights = [
+                        w + d for w, d in zip(self.weights, prev["delta"])
+                    ]
+                self._attempts[task_id] = {"attempt": int(attempt), "delta": None}
+            # else: stale/duplicate — keep the live attempt record
+
+    def commit_attempt(self, task_id: str) -> None:
+        """A task finished cleanly: drop its accumulator.
+
+        Bounds server memory to in-flight tasks only — without this, each of
+        P partitions would pin a model-sized accumulated delta for the whole
+        fit. A committed task that somehow still retries (shouldn't happen:
+        the facade only retries on exception) re-registers from scratch.
+        """
+        with self.lock:
+            self._attempts.pop(task_id, None)
 
     def get_weights(self) -> List[np.ndarray]:
         return self.weights
@@ -107,16 +168,39 @@ class HttpServer(BaseParameterServer):
                     self.send_error(404)
 
             def do_POST(self):
-                if self.path.rstrip("/") == "/update":
+                path = self.path.rstrip("/")
+                if path == "/update":
                     length = int(self.headers.get("Content-Length", 0))
                     delta = pickle.loads(self.rfile.read(length))
-                    server.apply_delta(delta)
-                    self.send_response(200)
-                    self.send_header("Content-Length", "2")
-                    self.end_headers()
-                    self.wfile.write(b"ok")
+                    # Optional task tag (exactly-once retry support); plain
+                    # reference-shaped clients omit it and behave as before.
+                    server.apply_delta(
+                        delta, task_id=self.headers.get("X-Elephas-Task")
+                    )
+                    self._ok()
+                elif path == "/register":
+                    length = int(self.headers.get("Content-Length", 0))
+                    if length:
+                        self.rfile.read(length)
+                    server.register_attempt(
+                        self.headers.get("X-Elephas-Task", ""),
+                        int(self.headers.get("X-Elephas-Attempt", 0)),
+                    )
+                    self._ok()
+                elif path == "/commit":
+                    length = int(self.headers.get("Content-Length", 0))
+                    if length:
+                        self.rfile.read(length)
+                    server.commit_attempt(self.headers.get("X-Elephas-Task", ""))
+                    self._ok()
                 else:
                     self.send_error(404)
+
+            def _ok(self):
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"ok")
 
         self._httpd = http.server.ThreadingHTTPServer(("0.0.0.0", self.port), Handler)
         self.port = self._httpd.server_address[1]  # resolves port=0 → OS port
@@ -140,7 +224,9 @@ class SocketServer(BaseParameterServer):
     fixed-width-header pickle framing from ``elephas_tpu.utils.sockets``.
 
     Reference: ``parameter/server.py:~100`` (``action_listener`` thread per
-    accepted connection).
+    accepted connection). Extension opcodes beyond the reference protocol:
+    ``b't'`` (task-tagged update) and ``b'r'`` (register task attempt) for
+    exactly-once retry semantics — see ``register_attempt``.
     """
 
     def __init__(self, weights: List[np.ndarray], mode: str = "asynchronous",
@@ -186,6 +272,20 @@ class SocketServer(BaseParameterServer):
                 elif op == b"u":
                     delta = socket_utils.receive(conn)
                     self.apply_delta(delta)
+                elif op == b"t":
+                    # tagged update: (task_id, delta) — exactly-once retries
+                    task_id, delta = socket_utils.receive(conn)
+                    self.apply_delta(delta, task_id=task_id)
+                elif op == b"r":
+                    # register (task_id, attempt); ack so the client can
+                    # order its first pull after the rollback
+                    task_id, attempt = socket_utils.receive(conn)
+                    self.register_attempt(task_id, attempt)
+                    conn.sendall(b"k")
+                elif op == b"c":
+                    # commit: task finished cleanly, drop its accumulator
+                    task_id = socket_utils.receive(conn)
+                    self.commit_attempt(task_id)
                 else:
                     break
         except (ConnectionError, OSError):

@@ -128,6 +128,37 @@ class AsynchronousSparkWorker:
         validation_split = float(self.train_config.get("validation_split", 0.0))
         verbose = self.train_config.get("verbose", 0)
 
+        # Exactly-once under task retry: register this (partition, attempt)
+        # with the server so a retry rolls back the failed attempt's pushes
+        # (the reference's async path is NOT retry-idempotent — SURVEY.md
+        # §5.3). Degrades to untagged pushes when the server predates the
+        # attempt API.
+        from .data import TaskContext
+
+        ctx = TaskContext.get()
+        task_id = None
+        if ctx is not None:
+            candidate = f"partition-{ctx.partitionId()}"
+            if self.client.register_attempt(candidate, ctx.attemptNumber()):
+                task_id = candidate
+            elif ctx.attemptNumber() > 0:
+                # No attempt API (e.g. native binary protocol): a retry here
+                # would re-push on top of the failed attempt's deltas — the
+                # exact double-apply hole tagged pushes exist to close. Fail
+                # fast instead (the job aborts once attempts are exhausted,
+                # which is the pre-retry behavior; resume via checkpoints).
+                raise RuntimeError(
+                    "async task retry is not safe without the parameter "
+                    "server attempt API; aborting instead of double-applying "
+                    f"deltas (task {candidate}, attempt {ctx.attemptNumber()})"
+                )
+
+        def push(delta):
+            if task_id is not None:
+                self.client.update_parameters_tagged(task_id, delta)
+            else:
+                self.client.update_parameters(delta)
+
         if self.frequency == "epoch":
             for _epoch in range(epochs):
                 weights_before = self.client.get_parameters()
@@ -137,7 +168,7 @@ class AsynchronousSparkWorker:
                     verbose=verbose, validation_split=validation_split,
                 )
                 delta = subtract_params_np(weights_before, model.get_weights())
-                self.client.update_parameters(delta)
+                push(delta)
         elif self.frequency == "batch":
             n = x_train.shape[0]
             if validation_split:
@@ -154,8 +185,12 @@ class AsynchronousSparkWorker:
                     delta = subtract_params_np(
                         weights_before, model.get_weights()
                     )
-                    self.client.update_parameters(delta)
+                    push(delta)
         else:
             raise ValueError(f"Unknown frequency: {self.frequency}")
+        if task_id is not None:
+            # Clean finish: release the server-side accumulator (memory stays
+            # bounded by in-flight tasks, not partition count).
+            self.client.commit_attempt(task_id)
         return
         yield  # make this a generator (mapPartitions contract), yielding nothing

@@ -1,0 +1,112 @@
+"""Task retry + TaskContext on the RDD facade.
+
+The reference inherits task retry from Spark L0 (``spark.task.maxFailures``,
+SURVEY.md §5.3); the facade reproduces it: each ``mapPartitions`` partition
+call is a task that runs under a ``TaskContext`` and is retried on exception.
+"""
+
+import threading
+
+import pytest
+
+from elephas_tpu.data import SparkConf, SparkContext, TaskContext, TaskFailedError
+
+
+def test_task_context_none_on_driver():
+    assert TaskContext.get() is None
+
+
+def test_task_context_inside_partition(spark_context):
+    rdd = spark_context.parallelize(range(8), 4)
+
+    def f(it):
+        ctx = TaskContext.get()
+        assert ctx is not None
+        yield (ctx.partitionId(), ctx.attemptNumber(), ctx.stageId())
+        # consume so the partition isn't empty-looking
+        list(it)
+
+    out = rdd.mapPartitions(f).collect()
+    pids = sorted(p for p, _, _ in out)
+    assert pids == [0, 1, 2, 3]
+    assert all(a == 0 for _, a, _ in out)
+    # all tasks of one mapPartitions call share a stage id
+    assert len({s for _, _, s in out}) == 1
+
+
+def test_flaky_partition_retried_until_success(spark_context):
+    rdd = spark_context.parallelize(range(8), 4)
+    failures = {"n": 0}
+    lock = threading.Lock()
+
+    def f(it):
+        ctx = TaskContext.get()
+        if ctx.partitionId() == 2 and ctx.attemptNumber() < 2:
+            with lock:
+                failures["n"] += 1
+            raise RuntimeError("injected fault")
+        yield sum(it) + ctx.attemptNumber()
+
+    out = rdd.mapPartitions(f).collect()
+    assert failures["n"] == 2
+    # partition 2 holds [4, 5] and succeeded on attempt 2
+    assert sorted(out) == [1, 5, 11, 13]
+
+
+def test_max_failures_exhausted_aborts_job(spark_context):
+    rdd = spark_context.parallelize(range(4), 2)
+
+    def always_fails(it):
+        raise RuntimeError("permanent fault")
+        yield
+
+    with pytest.raises(TaskFailedError) as e:
+        rdd.mapPartitions(always_fails).collect()
+    assert e.value.attempts == 4  # Spark's spark.task.maxFailures default
+    assert isinstance(e.value.cause, RuntimeError)
+
+
+def test_max_failures_configurable():
+    conf = (
+        SparkConf().setMaster("local[2]").setAppName("t")
+        .set("spark.task.maxFailures", 1)
+    )
+    sc = SparkContext(conf=conf)
+    assert sc.getConf().get("spark.task.maxFailures") == 1
+    attempts = {"n": 0}
+
+    def f(it):
+        attempts["n"] += 1
+        raise RuntimeError("boom")
+        yield
+
+    with pytest.raises(TaskFailedError):
+        sc.parallelize([1, 2], 1).mapPartitions(f).collect()
+    assert attempts["n"] == 1
+    sc.stop()
+
+
+def test_nested_map_partitions_restores_outer_context(spark_context):
+    """A partition function running its own local mapPartitions must get its
+    outer TaskContext back afterwards (restore, not clear)."""
+
+    def outer(it):
+        before = TaskContext.get()
+        # nested 1-partition job runs sequentially on this same thread
+        inner = spark_context.parallelize([1, 2, 3], 1)
+        inner_out = inner.mapPartitions(lambda i: [sum(i)]).collect()
+        after = TaskContext.get()
+        assert after is not None
+        yield (before.partitionId(), after.partitionId(), inner_out[0],
+               sum(it))
+
+    out = spark_context.parallelize(range(4), 2).mapPartitions(outer).collect()
+    for before_pid, after_pid, inner_sum, _ in out:
+        assert before_pid == after_pid
+        assert inner_sum == 6
+
+
+def test_context_cleared_after_tasks(spark_context):
+    rdd = spark_context.parallelize(range(4), 2)
+    rdd.mapPartitions(lambda it: [sum(it)]).collect()
+    assert TaskContext.get() is None

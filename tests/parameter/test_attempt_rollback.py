@@ -1,0 +1,240 @@
+"""Exactly-once retry semantics on the parameter servers.
+
+The reference's async path is not idempotent under Spark task retry — a
+retried task re-pushes deltas on top of the failed attempt's (SURVEY.md §5.3
+documents the hole). The rebuild fixes it: tagged updates are accumulated per
+task and a re-registered attempt rolls the previous attempt's contribution
+back. These tests drive the full client↔server wire path for both backends.
+"""
+
+import numpy as np
+import pytest
+
+from elephas_tpu.parameter.client import BaseParameterClient
+from elephas_tpu.parameter.server import HttpServer, SocketServer
+
+W0 = [np.zeros((3,), dtype="float64"), np.full((2, 2), 10.0)]
+
+
+def start(server_cls, mode="asynchronous"):
+    server = server_cls([w.copy() for w in W0], mode=mode, port=0)
+    server.start()
+    kind = "http" if server_cls is HttpServer else "socket"
+    client = BaseParameterClient.get_client(kind, port=server.port, host="127.0.0.1")
+    return server, client
+
+
+def delta(v):
+    return [np.full((3,), v), np.full((2, 2), v)]
+
+
+@pytest.mark.parametrize("server_cls", [HttpServer, SocketServer])
+def test_retry_rolls_back_failed_attempt(server_cls):
+    server, client = start(server_cls)
+    try:
+        assert client.register_attempt("partition-0", 0) is True
+        client.update_parameters_tagged("partition-0", delta(1.0))
+        client.update_parameters_tagged("partition-0", delta(2.0))
+        # ...task dies here, having already pushed 3.0 of delta; retry:
+        assert client.register_attempt("partition-0", 1) is True
+        client.update_parameters_tagged("partition-0", delta(5.0))
+        got = client.get_parameters()
+        # exactly-once: only the successful attempt's 5.0 survives
+        np.testing.assert_allclose(got[0], W0[0] - 5.0)
+        np.testing.assert_allclose(got[1], W0[1] - 5.0)
+    finally:
+        client.close()
+        server.stop()
+
+
+@pytest.mark.parametrize("server_cls", [HttpServer, SocketServer])
+def test_untagged_updates_keep_reference_behavior(server_cls):
+    """Plain reference-shaped pushes are untouched by the attempt machinery."""
+    server, client = start(server_cls)
+    try:
+        client.update_parameters(delta(1.0))
+        client.update_parameters(delta(2.0))
+        got = client.get_parameters()
+        np.testing.assert_allclose(got[0], W0[0] - 3.0)
+    finally:
+        client.close()
+        server.stop()
+
+
+@pytest.mark.parametrize("server_cls", [HttpServer, SocketServer])
+def test_independent_tasks_do_not_roll_back_each_other(server_cls):
+    server, client = start(server_cls)
+    try:
+        client.register_attempt("partition-0", 0)
+        client.register_attempt("partition-1", 0)
+        client.update_parameters_tagged("partition-0", delta(1.0))
+        client.update_parameters_tagged("partition-1", delta(2.0))
+        # partition-1 retries; partition-0's contribution must survive
+        client.register_attempt("partition-1", 1)
+        client.update_parameters_tagged("partition-1", delta(4.0))
+        got = client.get_parameters()
+        np.testing.assert_allclose(got[0], W0[0] - 5.0)
+    finally:
+        client.close()
+        server.stop()
+
+
+@pytest.mark.parametrize("server_cls", [HttpServer, SocketServer])
+def test_stale_register_cannot_roll_back_live_attempt(server_cls):
+    """A zombie executor replaying an OLD attempt's register must not undo the
+    live attempt's committed training (guard: only newer attempts roll back)."""
+    server, client = start(server_cls)
+    try:
+        client.register_attempt("partition-0", 1)
+        client.update_parameters_tagged("partition-0", delta(5.0))
+        # zombie replays attempt 0's registration — must be ignored
+        client.register_attempt("partition-0", 0)
+        got = client.get_parameters()
+        np.testing.assert_allclose(got[0], W0[0] - 5.0)
+        # and the live attempt can still retry correctly afterwards
+        client.register_attempt("partition-0", 2)
+        client.update_parameters_tagged("partition-0", delta(7.0))
+        got = client.get_parameters()
+        np.testing.assert_allclose(got[0], W0[0] - 7.0)
+    finally:
+        client.close()
+        server.stop()
+
+
+@pytest.mark.parametrize("server_cls", [HttpServer, SocketServer])
+def test_commit_frees_accumulator_and_keeps_weights(server_cls):
+    server, client = start(server_cls)
+    try:
+        client.register_attempt("partition-0", 0)
+        client.update_parameters_tagged("partition-0", delta(3.0))
+        client.commit_attempt("partition-0")
+        # a pull on the same connection orders after the commit opcode
+        got = client.get_parameters()
+        np.testing.assert_allclose(got[0], W0[0] - 3.0)
+        assert server._attempts == {}  # memory bounded by in-flight tasks
+        # a later register for the same partition starts a fresh history and
+        # cannot roll back the committed work
+        client.register_attempt("partition-0", 0)
+        got = client.get_parameters()
+        np.testing.assert_allclose(got[0], W0[0] - 3.0)
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_http_register_transient_error_raises_not_degrades():
+    """A 503 from /register is a transient fault on an attempt-API-capable
+    server — the client must surface it (task retry handles it), NOT silently
+    fall back to untagged pushes (which would reopen the double-apply hole)."""
+    import http.server
+    import threading
+    import urllib.error
+
+    class FlakyHandler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            self.send_error(503)
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), FlakyHandler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        client = BaseParameterClient.get_client(
+            "http", port=httpd.server_address[1], host="127.0.0.1"
+        )
+        with pytest.raises(urllib.error.HTTPError):
+            client.register_attempt("partition-0", 0)
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_socket_register_against_reference_server_degrades():
+    """A reference-shaped socket server (only 'g'/'u' opcodes) closes the
+    connection on the unknown 'r' opcode; the client must return False AND
+    recover its connection for plain pulls/pushes."""
+    import socket as socket_mod
+    import threading
+
+    from elephas_tpu.utils import sockets as socket_utils
+
+    weights = [np.zeros(2)]
+    srv = socket_mod.socket(socket_mod.AF_INET, socket_mod.SOCK_STREAM)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    stop = threading.Event()
+
+    def serve():
+        while not stop.is_set():
+            try:
+                srv.settimeout(0.2)
+                conn, _ = srv.accept()
+            except OSError:
+                continue
+            while True:
+                op = conn.recv(1)
+                if op == b"g":
+                    socket_utils.send(conn, weights)
+                elif op == b"u":
+                    socket_utils.receive(conn)
+                else:  # reference behavior: unknown opcode -> drop connection
+                    conn.close()
+                    break
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    try:
+        client = BaseParameterClient.get_client(
+            "socket", port=srv.getsockname()[1], host="127.0.0.1"
+        )
+        assert client.register_attempt("partition-0", 0) is False
+        # degraded path must still work on a fresh connection
+        client.update_parameters([np.ones(2)])
+        np.testing.assert_allclose(client.get_parameters()[0], weights[0])
+        client.close()
+    finally:
+        stop.set()
+        srv.close()
+
+
+def test_http_register_against_reference_server_degrades():
+    """A server without /register (the reference's Flask routes) → False."""
+    import http.server
+    import pickle
+    import threading
+
+    class RefHandler(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            payload = pickle.dumps([np.zeros(2)])
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def do_POST(self):
+            if self.path.rstrip("/") == "/update":
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+            else:
+                self.send_error(404)
+
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), RefHandler)
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        client = BaseParameterClient.get_client(
+            "http", port=httpd.server_address[1], host="127.0.0.1"
+        )
+        assert client.register_attempt("partition-0", 0) is False
+        client.update_parameters([np.ones(2)])  # plain push still works
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
