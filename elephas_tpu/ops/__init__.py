@@ -13,6 +13,7 @@ from .pallas_ops import (
     fused_xent_from_logits,
     xent_from_logits_reference,
 )
+from .layer_norm import fused_layer_norm, layer_norm, layer_norm_reference
 from .flash_attention import flash_attention
 from .ring_attention import attention_reference, ring_attention
 from .ulysses import ulysses_attention
@@ -21,6 +22,9 @@ __all__ = [
     "categorical_crossentropy_from_logits",
     "fused_xent_from_logits",
     "xent_from_logits_reference",
+    "fused_layer_norm",
+    "layer_norm",
+    "layer_norm_reference",
     "ring_attention",
     "attention_reference",
     "ulysses_attention",

@@ -1,0 +1,125 @@
+"""Fused LayerNorm Pallas kernel vs the jnp oracle (interpret mode on CPU).
+
+Covers padding-sensitive shapes (N not a multiple of 8, D not a multiple of
+128), leading batch dims, bf16 inputs, and full gradients (dx, dscale, dbias)
+through the custom VJP.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elephas_tpu.ops import fused_layer_norm, layer_norm_reference
+
+SHAPES = [
+    (8, 128),     # exact tiles
+    (5, 96),      # both dims padded
+    (13, 384),    # rows padded
+    (16, 200),    # lanes padded
+]
+
+
+def fused(x, s, b, eps=1e-5):
+    return fused_layer_norm(x, s, b, eps, True)  # interpret=True on CPU
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_forward_matches_reference(shape):
+    rng = np.random.default_rng(0)
+    n, d = shape
+    x = jnp.asarray(rng.normal(size=(n, d)), jnp.float32) * 3 + 1
+    s = jnp.asarray(rng.normal(size=(d,)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(d,)), jnp.float32)
+    np.testing.assert_allclose(
+        fused(x, s, b), layer_norm_reference(x, s, b), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_forward_leading_batch_dims():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 7, 96)), jnp.float32)
+    s = jnp.asarray(rng.normal(size=(96,)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(96,)), jnp.float32)
+    out = fused(x, s, b)
+    assert out.shape == x.shape
+    np.testing.assert_allclose(
+        out, layer_norm_reference(x, s, b), atol=1e-5, rtol=1e-5
+    )
+
+
+@pytest.mark.parametrize("shape", SHAPES)
+def test_gradients_match_reference(shape):
+    rng = np.random.default_rng(2)
+    n, d = shape
+    x = jnp.asarray(rng.normal(size=(n, d)), jnp.float32)
+    s = jnp.asarray(1 + 0.1 * rng.normal(size=(d,)), jnp.float32)
+    b = jnp.asarray(0.1 * rng.normal(size=(d,)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(n, d)), jnp.float32)  # non-uniform cotangent
+
+    def loss(f):
+        return lambda x, s, b: jnp.sum(w * f(x, s, b))
+
+    got = jax.grad(loss(fused), argnums=(0, 1, 2))(x, s, b)
+    want = jax.grad(loss(layer_norm_reference), argnums=(0, 1, 2))(x, s, b)
+    for g, r, name in zip(got, want, ("dx", "dscale", "dbias")):
+        np.testing.assert_allclose(g, r, atol=1e-4, rtol=1e-4, err_msg=name)
+
+
+@pytest.mark.parametrize("shape", [(8, 128), (5, 96)])
+def test_large_mean_small_spread_is_stable(shape):
+    """E[x²]−μ² would catastrophically cancel (or go NaN) here; the centered
+    masked variance must stay accurate with |μ| ≫ σ."""
+    rng = np.random.default_rng(6)
+    n, d = shape
+    x = jnp.asarray(1e4 + rng.normal(size=(n, d)), jnp.float32)
+    s = jnp.asarray(1 + 0.1 * rng.normal(size=(d,)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(d,)), jnp.float32)
+    out = fused(x, s, b)
+    assert np.isfinite(np.asarray(out)).all()
+    # oracle in float64 (the float32 jnp reference also cancels here)
+    x64 = np.asarray(x, np.float64)
+    mu = x64.mean(-1, keepdims=True)
+    var = x64.var(-1, keepdims=True)
+    want = (x64 - mu) / np.sqrt(var + 1e-5) * np.asarray(s) + np.asarray(b)
+    np.testing.assert_allclose(out, want.astype(np.float32), atol=5e-2, rtol=5e-2)
+    dx = jax.grad(lambda x: jnp.sum(fused(x, s, b)))(x)
+    assert np.isfinite(np.asarray(dx)).all()
+
+
+def test_bfloat16_input_f32_statistics():
+    rng = np.random.default_rng(3)
+    x32 = jnp.asarray(rng.normal(size=(9, 160)), jnp.float32)
+    s = jnp.ones((160,), jnp.float32)
+    b = jnp.zeros((160,), jnp.float32)
+    out = fused(x32.astype(jnp.bfloat16), s, b)
+    assert out.dtype == jnp.float32
+    np.testing.assert_allclose(
+        out, layer_norm_reference(x32, s, b), atol=2e-2, rtol=2e-2
+    )
+
+
+def test_grad_dtype_follows_primals():
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(8, 128)), jnp.bfloat16)
+    s = jnp.ones((128,), jnp.float32)
+    b = jnp.zeros((128,), jnp.bfloat16)
+    dx, ds, db = jax.grad(
+        lambda x, s, b: jnp.sum(fused(x, s, b)), argnums=(0, 1, 2)
+    )(x, s, b)
+    assert dx.dtype == jnp.bfloat16
+    assert ds.dtype == jnp.float32
+    assert db.dtype == jnp.bfloat16
+
+
+def test_jit_and_vmap_compose():
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(3, 10, 96)), jnp.float32)
+    s = jnp.ones((96,), jnp.float32)
+    b = jnp.zeros((96,), jnp.float32)
+    jitted = jax.jit(functools.partial(fused_layer_norm, eps=1e-5, interpret=True))
+    np.testing.assert_allclose(
+        jitted(x, s, b), layer_norm_reference(x, s, b), atol=1e-5, rtol=1e-5
+    )
