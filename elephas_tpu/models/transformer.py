@@ -37,6 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.flash_decode import decode_attention
 from ..ops.ring_attention import attention_reference, ring_attention_local
 from ..ops.ulysses import ulysses_attention_local
 from ..parallel.mesh import DATA_AXIS, build_mesh_2axis
@@ -296,14 +297,15 @@ class TransformerLM:
 
     # -- autoregressive inference (KV cache) ----------------------------
     def init_cache(self, batch: int, length: Optional[int] = None) -> Dict[str, Any]:
-        """Zeroed KV cache ``{"k"/"v": [L, B, length, Hkv, Dh]}`` (``length``
+        """Zeroed KV cache ``{"k"/"v": [L, B, Hkv, length, Dh]}`` (``length``
         defaults to ``max_len``; size it to the actual decode horizon —
-        every step attends over the whole cache). Under grouped-query
-        attention the cache holds only the KV heads: memory scales down by
-        ``n_heads / n_kv_heads``."""
+        every step attends over the whole cache). T rides the sublane axis
+        so the flash-decode kernel streams contiguous ``[BT, Dh]`` tiles per
+        (batch, kv-head). Under grouped-query attention the cache holds only
+        the KV heads: memory scales down by ``n_heads / n_kv_heads``."""
         L = self.n_layers
         T = self.max_len if length is None else int(length)
-        shape = (L, batch, T, self.n_kv_heads, self.d_model // self.n_heads)
+        shape = (L, batch, self.n_kv_heads, T, self.d_model // self.n_heads)
         z = jnp.zeros(shape, self.compute_dtype)
         return {"k": z, "v": z}
 
@@ -327,9 +329,11 @@ class TransformerLM:
 
         lps = {k: params[k] for k in self._block_keys()}
         h, (ks, vs) = jax.lax.scan(block, h, lps)  # ks/vs [L, B, T0, Hkv, Dh]
+        ks = ks.transpose(0, 1, 3, 2, 4)  # → cache layout [L, B, Hkv, T0, Dh]
+        vs = vs.transpose(0, 1, 3, 2, 4)
         cache = {
-            "k": jax.lax.dynamic_update_slice_in_dim(cache["k"], ks, 0, axis=2),
-            "v": jax.lax.dynamic_update_slice_in_dim(cache["v"], vs, 0, axis=2),
+            "k": jax.lax.dynamic_update_slice_in_dim(cache["k"], ks, 0, axis=3),
+            "v": jax.lax.dynamic_update_slice_in_dim(cache["v"], vs, 0, axis=3),
         }
         h = _layer_norm(h.astype(jnp.float32), params["lnf_s"],
                         params["lnf_b"])
@@ -348,45 +352,32 @@ class TransformerLM:
         Hkv = self.n_kv_heads
         Dh = self.d_model // H
         cd = self.compute_dtype
-        scale = Dh ** -0.5
-        cache_len = cache["k"].shape[2]
         pos_b = jnp.broadcast_to(jnp.asarray(pos), (B,))
         h = self._embed(params, token, pos_b)  # [B, D]
-        pos_mask = (jnp.arange(cache_len) <= pos)[None, None, :]  # [1,1,T]
         if self.pos_encoding == "rotary":
             r_cos, r_sin = _rope_angles(pos_b, Dh)  # [B, Dh/2]
             r_cos, r_sin = r_cos[:, None, :], r_sin[:, None, :]
 
         def block(h, inputs):
-            lp, kc, vc = inputs  # layer params; cache slices [B, T, Hkv, Dh]
+            lp, kc, vc = inputs  # layer params; cache slices [B, Hkv, T, Dh]
             x = _layer_norm(
                 h.astype(jnp.float32), lp["ln1_s"], lp["ln1_b"]
             ).astype(cd)
             q = (x @ lp["wq"].astype(cd)).reshape(B, H, Dh)
-            k_new = (x @ lp["wk"].astype(cd)).reshape(B, 1, Hkv, Dh)
-            v_new = (x @ lp["wv"].astype(cd)).reshape(B, 1, Hkv, Dh)
+            k_new = (x @ lp["wk"].astype(cd)).reshape(B, Hkv, 1, Dh)
+            v_new = (x @ lp["wv"].astype(cd)).reshape(B, Hkv, 1, Dh)
             if self.pos_encoding == "rotary":
                 # cache stores PRE-ROTATED keys (prefill does the same)
                 q = _rope_rotate(q, r_cos, r_sin)
                 k_new = _rope_rotate(k_new, r_cos[:, None], r_sin[:, None])
-            kc = jax.lax.dynamic_update_slice_in_dim(kc, k_new, pos, axis=1)
-            vc = jax.lax.dynamic_update_slice_in_dim(vc, v_new, pos, axis=1)
-            # grouped einsum straight against the Hkv-head cache: no
-            # expanded copy (query head h = kv_head·G + g, matching the
-            # repeat layout the training paths broadcast to)
+            kc = jax.lax.dynamic_update_slice_in_dim(kc, k_new, pos, axis=2)
+            vc = jax.lax.dynamic_update_slice_in_dim(vc, v_new, pos, axis=2)
+            # grouped attention straight against the Hkv-head cache (query
+            # head h = kv_head·G + g, matching the repeat layout the
+            # training paths broadcast to): flash-decode Pallas kernel on
+            # TPU (one VMEM pass over the cache), einsum reference elsewhere
             qg = q.reshape(B, Hkv, H // Hkv, Dh)
-            scores = jnp.einsum(
-                "bkgd,btkd->bkgt", qg, kc,
-                preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST,
-            ) * scale
-            scores = jnp.where(pos_mask[:, :, None, :], scores, -jnp.inf)
-            probs = jax.nn.softmax(scores, axis=-1)
-            a = jnp.einsum(
-                "bkgt,btkd->bkgd", probs, vc,
-                preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST,
-            ).astype(cd).reshape(B, H, Dh)
+            a = decode_attention(qg, kc, vc, pos).astype(cd).reshape(B, H, Dh)
             h = h + a.reshape(B, self.d_model) @ lp["wo"].astype(cd)
             x = _layer_norm(
                 h.astype(jnp.float32), lp["ln2_s"], lp["ln2_b"]
@@ -443,8 +434,12 @@ class TransformerLM:
 
         key = jax.random.PRNGKey(seed)
         key, k0 = jax.random.split(key)
+        # Cache horizon rounded so the flash-decode kernel's T-blocks fit
+        # without per-step padding (which would recopy the cache in HBM).
+        from ..ops.flash_decode import aligned_cache_length
+
         logits, cache = self.prefill(
-            params, prompt, self.init_cache(B, total)
+            params, prompt, self.init_cache(B, aligned_cache_length(total))
         )
         first = select(logits[:, -1], k0)
         buf = jnp.zeros((B, total), jnp.int32)

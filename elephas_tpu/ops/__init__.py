@@ -14,6 +14,11 @@ from .pallas_ops import (
     xent_from_logits_reference,
 )
 from .layer_norm import fused_layer_norm, layer_norm, layer_norm_reference
+from .flash_decode import (
+    decode_attention,
+    decode_attention_reference,
+    flash_decode,
+)
 from .flash_attention import flash_attention
 from .ring_attention import attention_reference, ring_attention
 from .ulysses import ulysses_attention
@@ -25,6 +30,9 @@ __all__ = [
     "fused_layer_norm",
     "layer_norm",
     "layer_norm_reference",
+    "decode_attention",
+    "decode_attention_reference",
+    "flash_decode",
     "ring_attention",
     "attention_reference",
     "ulysses_attention",

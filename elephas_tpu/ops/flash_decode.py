@@ -1,0 +1,179 @@
+"""Flash-decode: fused KV-cache attention for autoregressive inference.
+
+One decode step attends a single query position per sequence against the
+whole cache — a bandwidth-bound op (every step re-reads B·Hkv·T·Dh of K and
+V from HBM). Naive lowering materializes the [B, Hkv, G, T] score tensor in
+HBM twice (scores, probabilities); this kernel streams the cache through
+VMEM in T-blocks with flash-style online softmax, touching K/V once and
+never materializing probabilities off-chip.
+
+Grouped-query attention is native: the cache carries ``Hkv`` heads and the
+``G = H/Hkv`` query heads of a group share each K/V block from the same VMEM
+visit — the kernel's arithmetic intensity grows with G for free.
+
+The decode position ``pos`` is a *traced* scalar (it advances inside the
+generation ``lax.scan``), delivered via Pallas scalar prefetch so block
+index maps can see it: K/V blocks past ``pos`` are not even DMA'd — their
+index map clamps to the last live block and ``pl.when`` skips the compute.
+
+Cache layout is ``[B, Hkv, T, Dh]`` (T on the sublane axis) so each
+(batch, kv-head) grid cell streams contiguous ``[BT, Dh]`` tiles.
+
+Used by ``TransformerLM.decode_step`` via :func:`decode_attention` — Pallas
+on TPU, the jnp reference elsewhere (also the test oracle; the kernel runs
+under ``interpret=True`` on CPU in tests). No reference (b13n3rd/elephas)
+analog: the reference has no inference engine beyond ``model.predict``
+(SURVEY.md §2.5).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .pallas_ops import _LANE, _pad_up, is_tpu_backend
+
+_BLOCK_T = 256
+_SUBLANE = 8
+_NEG = -1e30
+
+
+def aligned_cache_length(length: int) -> int:
+    """Smallest cache length >= ``length`` whose T axis the kernel can
+    block without padding (pads in the decode hot loop would recopy the
+    whole cache in HBM every step). Extra positions are masked by ``pos``."""
+    bt = min(_BLOCK_T, _pad_up(int(length), _SUBLANE))
+    return _pad_up(int(length), bt)
+
+
+# -- reference (fallback / oracle) implementation ----------------------------
+
+
+def decode_attention_reference(q, k, v, pos):
+    """Grouped decode attention against a cache.
+
+    ``q`` [B, Hkv, G, Dh]; ``k``/``v`` [B, Hkv, T, Dh]; ``pos`` scalar int —
+    positions ``0..pos`` (inclusive) are visible. Returns [B, Hkv, G, Dh]
+    float32, softmax in f32.
+    """
+    dh = q.shape[-1]
+    scores = jnp.einsum(
+        "bkgd,bktd->bkgt", q, k, preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    ) * (dh ** -0.5)
+    mask = jnp.arange(k.shape[2])[None, None, None, :] <= pos
+    scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum(
+        "bkgt,bktd->bkgd", probs, v, preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+
+
+# -- pallas kernel ------------------------------------------------------------
+
+
+def _decode_kernel(d_true: int, block_t: int, pos_ref, q_ref, k_ref, v_ref,
+                   o_ref, m_s, l_s, acc_s):
+    from jax.experimental import pallas as pl
+
+    t = pl.program_id(2)
+
+    @pl.when(t == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, _NEG)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    start = t * block_t
+
+    @pl.when(start <= pos_ref[0])
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)  # [Gp, Dhp]
+        k = k_ref[0, 0].astype(jnp.float32)  # [BT, Dhp]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        ) * (d_true ** -0.5)                 # [Gp, BT]
+        j = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(j <= pos_ref[0], s, _NEG)
+        m_prev = m_s[:, :1]                  # [Gp, 1]
+        m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)               # [Gp, BT]
+        l_s[:] = alpha * l_s[:] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_s[:] = alpha * acc_s[:] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        m_s[:] = jnp.broadcast_to(m_cur, m_s.shape)
+
+    @pl.when(t == pl.num_programs(2) - 1)
+    def _finish():
+        o_ref[0, 0] = (acc_s[:] / l_s[:, :1]).astype(o_ref.dtype)
+
+
+def flash_decode(q, k, v, pos, interpret: bool = False):
+    """Fused decode attention (Pallas). Same contract as
+    :func:`decode_attention_reference`; ``pos`` may be a traced scalar."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, Hkv, G, Dh = q.shape
+    T = k.shape[2]
+    # Blocks never split G or Dh, so full-dim block shapes are legal at any
+    # size (Mosaic pads tiles in VMEM); only T is blocked and must align.
+    # Padding q is cheap (one query row per sequence); padding K/V is NOT —
+    # it would recopy the whole cache in HBM every decode step — so cache
+    # producers align T up front (generate() rounds the horizon with
+    # :func:`aligned_cache_length`) and the pads below are no-ops then.
+    Gp = _pad_up(G, _SUBLANE)
+    bt = min(_BLOCK_T, _pad_up(T, _SUBLANE))
+    Tp = _pad_up(T, bt)
+    qp = jnp.pad(q.astype(jnp.float32), ((0, 0), (0, 0), (0, Gp - G), (0, 0)))
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, Tp - T), (0, 0))) if Tp != T else k
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, Tp - T), (0, 0))) if Tp != T else v
+    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+    n_t = Tp // bt
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, Hkv, n_t),
+        in_specs=[
+            pl.BlockSpec((1, 1, Gp, Dh), lambda b, h, t, s: (b, h, 0, 0)),
+            # blocks past pos are never DMA'd: clamp to the last live block
+            pl.BlockSpec(
+                (1, 1, bt, Dh),
+                lambda b, h, t, s: (b, h, jnp.minimum(t, s[0] // bt), 0),
+            ),
+            pl.BlockSpec(
+                (1, 1, bt, Dh),
+                lambda b, h, t, s: (b, h, jnp.minimum(t, s[0] // bt), 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, 1, Gp, Dh), lambda b, h, t, s: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((Gp, _LANE), jnp.float32),   # running max (broadcast)
+            pltpu.VMEM((Gp, _LANE), jnp.float32),   # running denominator
+            pltpu.VMEM((Gp, Dh), jnp.float32),      # output accumulator
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, Dh, bt),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, Gp, Dh), jnp.float32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(pos_arr, qp, kp, vp)
+    return out[:, :, :G, :]
+
+
+def decode_attention(q, k, v, pos):
+    """Dispatcher: Pallas flash-decode on TPU, jnp reference elsewhere."""
+    if is_tpu_backend():
+        return flash_decode(q, k, v, pos)
+    return decode_attention_reference(q, k, v, pos)

@@ -182,9 +182,13 @@ fused_layer_norm.defvjp(_fused_fwd, _fused_bwd)
 
 
 def layer_norm(x, scale, bias, eps: float = 1e-5):
-    """Dispatcher: Pallas kernel on TPU, jnp reference elsewhere."""
+    """Dispatcher: Pallas kernel on TPU, jnp reference elsewhere.
+
+    Always returns float32 (the kernel's output dtype), so callers see one
+    dtype contract regardless of backend.
+    """
     from .pallas_ops import is_tpu_backend
 
     if is_tpu_backend():
         return fused_layer_norm(x, scale, bias, eps)
-    return layer_norm_reference(x, scale, bias, eps)
+    return layer_norm_reference(x, scale, bias, eps).astype(jnp.float32)
