@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.flash_decode import decode_attention
+from ..ops.flash_decode import aligned_cache_length, decode_attention
 from ..ops.ring_attention import attention_reference, ring_attention_local
 from ..ops.ulysses import ulysses_attention_local
 from ..parallel.mesh import DATA_AXIS, build_mesh_2axis
@@ -297,14 +297,17 @@ class TransformerLM:
 
     # -- autoregressive inference (KV cache) ----------------------------
     def init_cache(self, batch: int, length: Optional[int] = None) -> Dict[str, Any]:
-        """Zeroed KV cache ``{"k"/"v": [L, B, Hkv, length, Dh]}`` (``length``
-        defaults to ``max_len``; size it to the actual decode horizon —
-        every step attends over the whole cache). T rides the sublane axis
-        so the flash-decode kernel streams contiguous ``[BT, Dh]`` tiles per
-        (batch, kv-head). Under grouped-query attention the cache holds only
-        the KV heads: memory scales down by ``n_heads / n_kv_heads``."""
+        """Zeroed KV cache ``{"k"/"v": [L, B, Hkv, T, Dh]}`` where ``T`` is
+        ``length`` (default ``max_len``) rounded up to the flash-decode
+        T-block, so the kernel never pads (a pad would recopy the cache in
+        HBM every decode step); the extra positions are masked by ``pos``.
+        Size ``length`` to the actual decode horizon — every step attends
+        over the whole cache. T rides the sublane axis so the kernel streams
+        contiguous ``[BT, Dh]`` tiles per (batch, kv-head). Under
+        grouped-query attention the cache holds only the KV heads: memory
+        scales down by ``n_heads / n_kv_heads``."""
         L = self.n_layers
-        T = self.max_len if length is None else int(length)
+        T = aligned_cache_length(self.max_len if length is None else length)
         shape = (L, batch, self.n_kv_heads, T, self.d_model // self.n_heads)
         z = jnp.zeros(shape, self.compute_dtype)
         return {"k": z, "v": z}
@@ -434,12 +437,8 @@ class TransformerLM:
 
         key = jax.random.PRNGKey(seed)
         key, k0 = jax.random.split(key)
-        # Cache horizon rounded so the flash-decode kernel's T-blocks fit
-        # without per-step padding (which would recopy the cache in HBM).
-        from ..ops.flash_decode import aligned_cache_length
-
         logits, cache = self.prefill(
-            params, prompt, self.init_cache(B, aligned_cache_length(total))
+            params, prompt, self.init_cache(B, total)
         )
         first = select(logits[:, -1], k0)
         buf = jnp.zeros((B, total), jnp.int32)

@@ -1,11 +1,14 @@
 """Custom TPU ops.
 
 ``pallas_ops`` holds the fused classification-loss kernel (used automatically
-on TPU via ``models.losses``); ``ring_attention`` and ``ulysses`` provide
-the two canonical sequence-parallel exact-attention schedules over the mesh
-(explicitly-labeled extensions — the reference has no long-context support,
-SURVEY.md §5.7). jnp reference implementations double as CPU fallbacks and
-test oracles.
+on TPU via ``models.losses``); ``layer_norm`` the fused LayerNorm (custom
+VJP) behind the LM family's norms; ``flash_decode`` the GQA-native KV-cache
+decode-attention kernel behind ``TransformerLM.decode_step``;
+``flash_attention`` the blockwise training-time attention; ``ring_attention``
+and ``ulysses`` the two canonical sequence-parallel exact-attention schedules
+over the mesh (explicitly-labeled extensions — the reference has no
+long-context support, SURVEY.md §5.7). jnp reference implementations double
+as CPU fallbacks and test oracles.
 """
 
 from .pallas_ops import (

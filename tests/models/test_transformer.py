@@ -240,7 +240,9 @@ def test_gqa_matches_dense_and_shrinks_cache(n_kv, pos_enc):
     # cache holds only the KV heads; cached decode still equals the full
     # forward's logits position-by-position
     cache = model.init_cache(batch=tokens.shape[0], length=12)
-    assert cache["k"].shape == (2, tokens.shape[0], n_kv, 12, 4)
+    # length rounds up to the flash-decode T-block (12 → 16); extra
+    # positions are masked by pos
+    assert cache["k"].shape == (2, tokens.shape[0], n_kv, 16, 4)
     toks12 = jnp.asarray(tokens[:, :12])
     full = np.asarray(model.apply(params, toks12, positions[:, :12],
                                   attn="dense"))

@@ -1,0 +1,132 @@
+"""Parameter-server torture tests: concurrency, conservation, linearizability.
+
+The reference has no race detection (SURVEY.md §5.2 — races are a *feature*
+in hogwild). These tests give the rebuild an explicit concurrency contract:
+
+- locked ``asynchronous`` mode is linearizable for updates — under heavy
+  multi-client hammering the final weights equal start − Σdeltas exactly
+  (update application is read-modify-write under the lock, so no update can
+  be lost);
+- attempt registration/rollback composes with that contract under
+  concurrency (rolled-back attempts subtract out exactly);
+- ``hogwild`` mode must stay *available* under the same hammering (no
+  deadlock, finite weights) — lost updates are its documented contract, so
+  only liveness is asserted.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from elephas_tpu.parameter.client import BaseParameterClient
+from elephas_tpu.parameter.server import HttpServer, SocketServer
+
+N_CLIENTS = 8
+N_UPDATES = 25
+
+
+def hammer(kind, port, client_fn):
+    errs = []
+
+    def worker(i):
+        try:
+            client = BaseParameterClient.get_client(kind, port=port, host="127.0.0.1")
+            client_fn(client, i)
+            client.close()
+        except Exception as e:  # noqa: BLE001 — collected for the assertion
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(N_CLIENTS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errs, errs
+    assert not any(t.is_alive() for t in threads), "deadlocked client threads"
+
+
+@pytest.mark.parametrize("server_cls,kind", [(HttpServer, "http"),
+                                             (SocketServer, "socket")])
+def test_locked_async_conserves_every_update(server_cls, kind):
+    w0 = [np.zeros((4, 4)), np.zeros((7,))]
+    server = server_cls([w.copy() for w in w0], mode="asynchronous", port=0)
+    server.start()
+    try:
+        def client_fn(client, i):
+            for u in range(N_UPDATES):
+                delta = [np.full((4, 4), 1.0), np.full((7,), float(u % 3))]
+                client.update_parameters(delta)
+                if u % 5 == 0:
+                    client.get_parameters()  # interleave reads
+            # socket pushes are fire-and-forget; a trailing pull on the same
+            # connection orders after them, draining this client's stream
+            client.get_parameters()
+
+        hammer(kind, server.port, client_fn)
+        got = server.get_weights()
+        total0 = N_CLIENTS * N_UPDATES * 1.0
+        total1 = N_CLIENTS * sum(float(u % 3) for u in range(N_UPDATES))
+        np.testing.assert_allclose(got[0], -np.full((4, 4), total0))
+        np.testing.assert_allclose(got[1], -np.full((7,), total1))
+    finally:
+        server.stop()
+
+
+@pytest.mark.parametrize("server_cls,kind", [(HttpServer, "http"),
+                                             (SocketServer, "socket")])
+def test_rollback_composes_under_concurrency(server_cls, kind):
+    """Half the clients run a failed attempt (rolled back) then a clean one;
+    the other half push untagged. Final = start − (untagged + clean tagged)."""
+    w0 = [np.zeros((5,))]
+    server = server_cls([w.copy() for w in w0], mode="asynchronous", port=0)
+    server.start()
+    try:
+        def client_fn(client, i):
+            if i % 2 == 0:
+                tid = f"task-{i}"
+                assert client.register_attempt(tid, 0)
+                for _ in range(N_UPDATES):
+                    client.update_parameters_tagged(tid, [np.full((5,), 7.0)])
+                # "crash": a new attempt registers, undoing all of the above
+                assert client.register_attempt(tid, 1)
+                client.update_parameters_tagged(tid, [np.full((5,), 2.0)])
+                client.commit_attempt(tid)
+            else:
+                for _ in range(N_UPDATES):
+                    client.update_parameters([np.full((5,), 1.0)])
+            client.get_parameters()  # drain this connection's stream
+
+        hammer(kind, server.port, client_fn)
+        got = server.get_weights()
+        tagged = (N_CLIENTS // 2) * 2.0
+        untagged = (N_CLIENTS // 2) * N_UPDATES * 1.0
+        np.testing.assert_allclose(got[0], -np.full((5,), tagged + untagged))
+        assert server._attempts == {}  # all committed → memory released
+    finally:
+        server.stop()
+
+
+@pytest.mark.parametrize("server_cls,kind", [(HttpServer, "http"),
+                                             (SocketServer, "socket")])
+def test_hogwild_stays_live_under_hammering(server_cls, kind):
+    """Hogwild's contract is availability, not conservation: the server must
+    survive concurrent lock-free updates without deadlock or corruption
+    beyond lost updates (weights finite, correct shapes)."""
+    w0 = [np.zeros((16,))]
+    server = server_cls([w.copy() for w in w0], mode="hogwild", port=0)
+    server.start()
+    try:
+        def client_fn(client, i):
+            for _ in range(N_UPDATES):
+                client.update_parameters([np.full((16,), 1.0)])
+
+        hammer(kind, server.port, client_fn)
+        got = server.get_weights()
+        assert got[0].shape == (16,)
+        assert np.isfinite(got[0]).all()
+        # every element saw at least one and at most all updates
+        assert (-got[0] >= 1.0).all()
+        assert (-got[0] <= N_CLIENTS * N_UPDATES).all()
+    finally:
+        server.stop()
