@@ -1,0 +1,168 @@
+"""Delta compression for parameter-server traffic (TPU-native extension).
+
+The reference pickles the FULL float32 weight list on every push
+(``elephas/parameter/client.py:~20`` — no compression, SURVEY.md §2.4), so
+PS bandwidth scales with model size × push rate. These codecs shrink the
+*delta* pushes (pulls stay exact — replicas must start from true weights):
+
+- ``int8``: per-array linear quantization to int8 (scale = max|x|/127),
+  ~4× smaller, error bounded by scale/2 per element.
+- ``topk:F``: keep the fraction ``F`` of entries with largest magnitude
+  (values + flat indices), ~``1/F × 1/2``-ish smaller. Pairs with
+  client-side **error feedback**: the dropped residual is remembered and
+  added to the next delta, so nothing is lost over time — the standard
+  trick that keeps sparsified SGD converging.
+
+Codecs are applied client-side via :class:`CompressingClient` (a wrapper
+over any :class:`~elephas_tpu.parameter.client.BaseParameterClient`) and
+decoded server-side in ``apply_delta`` — the wire stays "a pickled object",
+so compressed and plain clients interoperate against one server. Enable
+with ``SparkModel(compression='int8' | 'topk:0.01')``.
+
+Explicitly an extension: the reference has no gradient/delta compression of
+any kind (SURVEY.md §2.3 "explicitly ABSENT" list).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+_MARKER = "__elephas_codec__"
+
+
+# -- codecs -------------------------------------------------------------------
+
+
+class Int8Codec:
+    """Per-array linear int8 quantization of a weight-delta list."""
+
+    name = "int8"
+
+    def encode(self, deltas: List[np.ndarray]) -> dict:
+        arrays = []
+        for d in deltas:
+            d = np.asarray(d, np.float32)
+            scale = float(np.max(np.abs(d))) / 127.0 if d.size else 0.0
+            q = (np.zeros(d.shape, np.int8) if scale == 0.0
+                 else np.clip(np.round(d / scale), -127, 127).astype(np.int8))
+            arrays.append({"shape": d.shape, "scale": scale, "q": q})
+        return {_MARKER: self.name, "arrays": arrays}
+
+    @staticmethod
+    def decode(payload: dict) -> List[np.ndarray]:
+        out = []
+        for a in payload["arrays"]:
+            out.append((a["q"].astype(np.float32) * a["scale"]).reshape(a["shape"]))
+        return out
+
+
+class TopKCodec:
+    """Magnitude top-k sparsification with client-side error feedback.
+
+    ``fraction`` of entries (per array, at least 1) survive; the rest are
+    remembered in ``self.residual`` and folded into the next ``encode`` —
+    over time every coordinate's contribution reaches the server.
+    """
+
+    def __init__(self, fraction: float):
+        if not 0.0 < fraction <= 1.0:
+            raise ValueError(f"top-k fraction must be in (0, 1], got {fraction}")
+        self.fraction = float(fraction)
+        self.name = f"topk:{self.fraction}"
+        self.residual: Optional[List[np.ndarray]] = None
+
+    def encode(self, deltas: List[np.ndarray]) -> dict:
+        if self.residual is None:
+            self.residual = [np.zeros_like(np.asarray(d, np.float32))
+                             for d in deltas]
+        arrays = []
+        for i, d in enumerate(deltas):
+            d = np.asarray(d, np.float32) + self.residual[i]
+            flat = d.ravel()
+            k = max(1, int(round(flat.size * self.fraction)))
+            idx = np.argpartition(np.abs(flat), flat.size - k)[-k:]
+            vals = flat[idx]
+            res = d.copy()
+            res.ravel()[idx] = 0.0     # what the server got leaves the residual
+            self.residual[i] = res
+            arrays.append({"shape": d.shape,
+                           "idx": idx.astype(np.int64),
+                           "vals": vals.astype(np.float32)})
+        return {_MARKER: "topk", "arrays": arrays}
+
+    @staticmethod
+    def decode(payload: dict) -> List[np.ndarray]:
+        out = []
+        for a in payload["arrays"]:
+            flat = np.zeros(int(np.prod(a["shape"])), np.float32)
+            flat[a["idx"]] = a["vals"]
+            out.append(flat.reshape(a["shape"]))
+        return out
+
+
+def make_codec(spec: Optional[str]):
+    """``None``/``'none'`` → None; ``'int8'``; ``'topk:F'`` (e.g. 0.01)."""
+    if spec is None or spec == "none":
+        return None
+    if spec == "int8":
+        return Int8Codec()
+    if spec.startswith("topk:"):
+        return TopKCodec(float(spec.split(":", 1)[1]))
+    raise ValueError(f"Unknown compression spec: {spec!r}")
+
+
+def maybe_decode(obj: Any) -> List[np.ndarray]:
+    """Server-side: transparently decode a compressed push; pass plain
+    weight lists through untouched (reference-shaped clients)."""
+    if isinstance(obj, dict) and _MARKER in obj:
+        kind = obj[_MARKER]
+        if kind == "int8":
+            return Int8Codec.decode(obj)
+        if kind == "topk":
+            return TopKCodec.decode(obj)
+        raise ValueError(f"Unknown codec marker: {kind!r}")
+    return obj
+
+
+# -- client wrapper -----------------------------------------------------------
+
+
+class CompressingClient:
+    """Wraps any parameter client: pushes encoded deltas, pulls untouched.
+
+    One wrapper per worker thread (the top-k residual is per-client state,
+    like the reference's one-client-per-executor layout).
+    """
+
+    def __init__(self, inner, codec):
+        self._inner = inner
+        self._codec = codec
+
+    def get_parameters(self):
+        return self._inner.get_parameters()
+
+    def update_parameters(self, delta):
+        self._inner.update_parameters(self._codec.encode(delta))
+
+    def register_attempt(self, task_id, attempt):
+        return self._inner.register_attempt(task_id, attempt)
+
+    def update_parameters_tagged(self, task_id, delta):
+        self._inner.update_parameters_tagged(task_id, self._codec.encode(delta))
+
+    def commit_attempt(self, task_id):
+        self._inner.commit_attempt(task_id)
+
+    def close(self):
+        # Flush any error-feedback residual as one final exact push: with
+        # few pushes per task (e.g. frequency='epoch', epochs=1) most of the
+        # delta mass would otherwise die with the client, breaking the
+        # "nothing is lost over time" contract. Success path only — a
+        # crashed task never reaches close(), and its retry starts clean.
+        residual = getattr(self._codec, "residual", None)
+        if residual is not None and any(np.abs(r).max() > 0 for r in residual):
+            self._inner.update_parameters(residual)
+            self._codec.residual = None
+        self._inner.close()

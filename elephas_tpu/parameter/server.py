@@ -57,6 +57,10 @@ class BaseParameterServer:
     # -- weight ops ------------------------------------------------------
     def apply_delta(self, delta: List[np.ndarray],
                     task_id: Optional[str] = None) -> None:
+        from .compression import maybe_decode
+
+        delta = maybe_decode(delta)  # transparent: plain lists pass through
+
         def _apply():
             self.weights = subtract_params_np(self.weights, delta)
             if task_id is not None and task_id in self._attempts:

@@ -71,6 +71,7 @@ class SparkModel:
                  custom_objects: Optional[dict] = None, batch_size: int = 32,
                  port: int = 4000, mesh=None, merge: str = "auto",
                  comm: Optional[str] = None, remat: bool = False,
+                 compression: Optional[str] = None,
                  master_optimizer=None, master_loss=None, master_metrics=None,
                  *args, **kwargs):
         if mode not in ("synchronous", "asynchronous", "hogwild"):
@@ -98,6 +99,27 @@ class SparkModel:
             else:
                 comm = "jax" if parameter_server_mode == "jax" else "host"
         self.comm = comm
+        # Delta compression for host PS pushes ('int8' | 'topk:F' | None) —
+        # an extension; the reference pushes full f32 lists (SURVEY.md §2.4).
+        # Only the host async paths have PS traffic to compress; reject the
+        # knob anywhere it would be silently ignored.
+        if compression:
+            if parameter_server_mode == "native":
+                raise ValueError(
+                    "compression is not supported with the native binary "
+                    "protocol (use 'http' or 'socket')"
+                )
+            if comm != "host":
+                raise ValueError(
+                    "compression applies to the host parameter-server "
+                    "paths (asynchronous/hogwild with http or socket); "
+                    f"this model runs comm={comm!r}, which has no PS "
+                    "traffic to compress"
+                )
+            from .parameter.compression import make_codec
+
+            make_codec(compression)  # validate the spec eagerly
+        self.compression = compression
         self.master_optimizer = (
             master_optimizer
             if master_optimizer is not None
@@ -135,6 +157,7 @@ class SparkModel:
             "merge": self.merge,
             "comm": self.comm,
             "remat": self.remat,
+            "compression": self.compression,
         }
 
     # -- training --------------------------------------------------------
@@ -339,9 +362,16 @@ class SparkModel:
                 [w.shape for w in weights], [w.dtype for w in weights],
                 self.port,
             )
-        return BaseParameterClient.get_client(
+        client = BaseParameterClient.get_client(
             self.parameter_server_mode, self.port, host="127.0.0.1"
         )
+        if self.compression:
+            from .parameter.compression import CompressingClient, make_codec
+
+            # fresh codec per client: top-k error-feedback residual is
+            # per-worker state (one client per executor, like the reference)
+            client = CompressingClient(client, make_codec(self.compression))
+        return client
 
     def stop_server(self) -> None:
         if self._server is not None:
@@ -512,6 +542,7 @@ def load_spark_model(path: str, custom_objects: Optional[dict] = None) -> SparkM
         merge=config.get("merge", "auto"),
         comm=config.get("comm"),
         remat=config.get("remat", False),
+        compression=config.get("compression"),
     )
 
 
