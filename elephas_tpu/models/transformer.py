@@ -37,7 +37,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.flash_attention import flash_attention
 from ..ops.flash_decode import aligned_cache_length, decode_attention
+from ..ops.pallas_ops import is_tpu_backend
 from ..ops.ring_attention import attention_reference, ring_attention_local
 from ..ops.ulysses import ulysses_attention_local
 from ..parallel.mesh import DATA_AXIS, build_mesh_2axis
@@ -322,10 +324,16 @@ class TransformerLM:
 
         rope = self._rope_for(positions)
 
+        def prefill_attend(q, k, v):
+            # Long prompts: blockwise flash attention on TPU keeps prefill
+            # memory O(T·block) instead of the dense T² score tensor.
+            if is_tpu_backend():
+                return flash_attention(q, k, v, causal=True)
+            return attention_reference(q, k, v, causal=True)
+
         def block(h, lp):
             h, _, k, v = self._block_fwd(
-                h, lp,
-                lambda q, k, v: attention_reference(q, k, v, causal=True),
+                h, lp, prefill_attend,
                 "dense", SEQ_AXIS, ep_groups=1, rope=rope,
             )
             return h, (k, v)

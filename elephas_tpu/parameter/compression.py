@@ -82,7 +82,10 @@ class TopKCodec:
             d = np.asarray(d, np.float32) + self.residual[i]
             flat = d.ravel()
             k = max(1, int(round(flat.size * self.fraction)))
-            idx = np.argpartition(np.abs(flat), flat.size - k)[-k:]
+            if flat.size == 0 or k >= flat.size:
+                idx = np.arange(flat.size)  # zero-size or keep-everything
+            else:
+                idx = np.argpartition(np.abs(flat), flat.size - k)[-k:]
             vals = flat[idx]
             res = d.copy()
             res.ravel()[idx] = 0.0     # what the server got leaves the residual
@@ -152,17 +155,29 @@ class CompressingClient:
     def update_parameters_tagged(self, task_id, delta):
         self._inner.update_parameters_tagged(task_id, self._codec.encode(delta))
 
+    def _flush_residual(self, task_id=None):
+        """Push any error-feedback residual as one final exact delta: with
+        few pushes per task (e.g. frequency='epoch', epochs=1) most of the
+        delta mass would otherwise die with the client."""
+        residual = getattr(self._codec, "residual", None)
+        if residual is not None and any(np.abs(r).max() > 0 for r in residual):
+            if task_id is not None:
+                self._inner.update_parameters_tagged(task_id, residual)
+            else:
+                self._inner.update_parameters(residual)
+            self._codec.residual = None
+
     def commit_attempt(self, task_id):
+        # Flush BEFORE committing, tagged with the task: if the flush (or
+        # the commit) fails, the task fails pre-commit and the retry's
+        # rollback erases everything — exactly-once is preserved. Flushing
+        # after commit would leave a window where a failed untagged flush
+        # retries on top of committed pushes.
+        self._flush_residual(task_id)
         self._inner.commit_attempt(task_id)
 
     def close(self):
-        # Flush any error-feedback residual as one final exact push: with
-        # few pushes per task (e.g. frequency='epoch', epochs=1) most of the
-        # delta mass would otherwise die with the client, breaking the
-        # "nothing is lost over time" contract. Success path only — a
-        # crashed task never reaches close(), and its retry starts clean.
-        residual = getattr(self._codec, "residual", None)
-        if residual is not None and any(np.abs(r).max() > 0 for r in residual):
-            self._inner.update_parameters(residual)
-            self._codec.residual = None
+        # Untagged workflow (no attempt API): best-effort flush on the
+        # success path — consistent with that mode's at-least-once contract.
+        self._flush_residual()
         self._inner.close()

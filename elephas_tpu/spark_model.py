@@ -109,11 +109,11 @@ class SparkModel:
                     "compression is not supported with the native binary "
                     "protocol (use 'http' or 'socket')"
                 )
-            if comm != "host":
+            if comm != "host" or mode == "synchronous":
                 raise ValueError(
                     "compression applies to the host parameter-server "
                     "paths (asynchronous/hogwild with http or socket); "
-                    f"this model runs comm={comm!r}, which has no PS "
+                    f"mode={mode!r} with comm={comm!r} has no PS "
                     "traffic to compress"
                 )
             from .parameter.compression import make_codec

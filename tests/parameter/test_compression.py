@@ -137,6 +137,41 @@ def test_close_flushes_topk_residual():
         server.stop()
 
 
+def test_commit_flushes_residual_tagged():
+    """The residual flush rides the attempt record (flush BEFORE commit,
+    tagged): the server sees the full delta, and a post-commit retry
+    cannot double-apply it."""
+    w0 = [np.zeros((10, 10))]
+    server = HttpServer([w.copy() for w in w0], mode="asynchronous", port=0)
+    server.start()
+    try:
+        comp = CompressingClient(
+            BaseParameterClient.get_client("http", port=server.port,
+                                           host="127.0.0.1"),
+            make_codec("topk:0.1"),
+        )
+        assert comp.register_attempt("task-0", 0)
+        delta = [np.arange(1.0, 101.0, dtype=np.float32).reshape(10, 10)]
+        comp.update_parameters_tagged("task-0", delta)
+        comp.commit_attempt("task-0")
+        np.testing.assert_allclose(server.get_weights()[0], -delta[0])
+        assert server._attempts == {}
+        comp.close()
+    finally:
+        server.stop()
+
+
+def test_topk_handles_empty_and_full_fractions():
+    codec = TopKCodec(0.5)
+    d = [np.zeros((0,), np.float32), np.ones((3,), np.float32)]
+    back = maybe_decode(codec.encode(d))
+    assert back[0].shape == (0,)
+    # keep-everything edge: fraction 1.0 transmits the delta exactly
+    full = TopKCodec(1.0)
+    back = maybe_decode(full.encode([np.arange(5.0, dtype=np.float32)]))
+    np.testing.assert_allclose(back[0], np.arange(5.0))
+
+
 def test_compression_rejected_on_non_host_paths(classifier_factory):
     from elephas_tpu import SparkModel
 
@@ -146,6 +181,10 @@ def test_compression_rejected_on_non_host_paths(classifier_factory):
     with pytest.raises(ValueError, match="no PS traffic"):
         SparkModel(classifier_factory(), mode="asynchronous",
                    parameter_server_mode="jax", compression="int8")
+    with pytest.raises(ValueError, match="no PS traffic"):
+        # sync host path collects deltas via mapPartitions, not a PS client
+        SparkModel(classifier_factory(), mode="synchronous", comm="host",
+                   compression="int8")
 
 
 def test_save_load_roundtrips_compression(classifier_factory, tmp_path):
