@@ -326,10 +326,19 @@ class TransformerLM:
 
         def prefill_attend(q, k, v):
             # Long prompts: blockwise flash attention on TPU keeps prefill
-            # memory O(T·block) instead of the dense T² score tensor.
-            if is_tpu_backend():
-                return flash_attention(q, k, v, causal=True)
-            return attention_reference(q, k, v, causal=True)
+            # memory O(T·block) instead of the dense T² score tensor. Flash
+            # picks its block as a divisor of T, so pad T to a 128 multiple
+            # first — an arbitrary (prime) prompt length would otherwise
+            # degrade to block 1. Padded keys sit at positions every real
+            # query's causal mask excludes; padded query rows are sliced.
+            if not is_tpu_backend():
+                return attention_reference(q, k, v, causal=True)
+            T = q.shape[1]
+            Tp = -(-T // 128) * 128
+            if Tp != T:
+                pad = ((0, 0), (0, Tp - T), (0, 0), (0, 0))
+                q, k, v = (jnp.pad(a, pad) for a in (q, k, v))
+            return flash_attention(q, k, v, causal=True)[:, :T]
 
         def block(h, lp):
             h, _, k, v = self._block_fwd(

@@ -3,7 +3,7 @@ from .composite import (
     build_3d_train_step,
     build_mesh_3d,
 )
-from .distributed import global_mesh, initialize_cluster
+from .distributed import global_mesh, hybrid_mesh, initialize_cluster
 from .engine import CompiledTrainer, FitResult
 from .expert import (
     EXPERT_AXIS,
@@ -56,4 +56,5 @@ __all__ = [
     "pipeline_apply",
     "initialize_cluster",
     "global_mesh",
+    "hybrid_mesh",
 ]

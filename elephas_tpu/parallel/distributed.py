@@ -54,3 +54,42 @@ def global_mesh(axis_name: str = "data"):
     from .mesh import build_mesh
 
     return build_mesh(axis_name=axis_name)
+
+
+def hybrid_mesh(dcn_axis: str = "data", ici_axis: str = "model",
+                ici_size: Optional[int] = None, devices=None):
+    """A 2-D ``(dcn_axis, ici_axis)`` mesh laid out so the INNER axis stays
+    within a host and the outer axis spans hosts.
+
+    The scaling-book recipe for multi-host TPU: put the bandwidth-hungry
+    dimension (tensor/fsdp/sequence sharding — per-step ``all_gather``/
+    ``psum_scatter`` traffic) on ``ici_axis`` so its collectives ride ICI,
+    and the once-per-step gradient reduction (data parallelism) on
+    ``dcn_axis``, the only traffic that crosses DCN. ``jax.devices()``
+    orders devices by process, so reshaping ``[n_hosts*local] →
+    [dcn, ici]`` with ``ici = local_device_count`` (the default) keeps each
+    inner group on one host; an explicit ``ici_size`` must divide the local
+    device count for that property to survive — enforced here.
+
+    Works identically on a forced-multi-device CPU mesh (tests) and a real
+    pod after :func:`initialize_cluster`.
+    """
+    import jax
+
+    from .mesh import build_mesh_2axis
+
+    devs = list(devices) if devices is not None else list(jax.devices())
+    local = jax.local_device_count() if devices is None else len(devs)
+    if ici_size is None:
+        ici_size = local
+    if local % ici_size and devices is None:
+        raise ValueError(
+            f"ici_size={ici_size} must divide local_device_count={local} "
+            "so the inner mesh axis stays within one host"
+        )
+    if len(devs) % ici_size:
+        raise ValueError(
+            f"{len(devs)} devices do not split into ici groups of {ici_size}"
+        )
+    return build_mesh_2axis(ici_axis, second=ici_size, devices=devs,
+                            first_axis=dcn_axis)

@@ -36,12 +36,14 @@ def build_mesh(num_devices: Optional[int] = None,
 
 def build_mesh_2axis(second_axis: str, data: Optional[int] = None,
                      second: int = 1,
-                     devices: Optional[Sequence] = None) -> Mesh:
-    """A 2-D ``("data", <second_axis>)`` mesh — the shared builder behind
-    ``build_mesh2d`` (tp), ``build_mesh_pp`` (pp), and ``build_mesh_ep``
-    (ep). ``data`` defaults to ``len(devices) // second``; adjacent devices
-    land on the same second-axis group (innermost), which on a real pod
-    keeps that axis's collectives on nearest-neighbor ICI links.
+                     devices: Optional[Sequence] = None,
+                     first_axis: str = DATA_AXIS) -> Mesh:
+    """A 2-D ``(<first_axis>, <second_axis>)`` mesh (first axis defaults to
+    ``"data"``) — the shared builder behind ``build_mesh2d`` (tp),
+    ``build_mesh_pp`` (pp), ``build_mesh_ep`` (ep), and ``hybrid_mesh``
+    (DCN×ICI). ``data`` defaults to ``len(devices) // second``; adjacent
+    devices land on the same second-axis group (innermost), which on a real
+    pod keeps that axis's collectives on nearest-neighbor ICI links.
     """
     devs = list(devices) if devices is not None else list(jax.devices())
     if second < 1:
@@ -54,7 +56,7 @@ def build_mesh_2axis(second_axis: str, data: Optional[int] = None,
             f"mesh {data}x{second} needs {need} devices, have {len(devs)}"
         )
     grid = np.array(devs[:need]).reshape(data, second)
-    return Mesh(grid, (DATA_AXIS, second_axis))
+    return Mesh(grid, (first_axis, second_axis))
 
 
 def replicated_spec() -> PartitionSpec:

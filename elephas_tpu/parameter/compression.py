@@ -160,7 +160,9 @@ class CompressingClient:
         few pushes per task (e.g. frequency='epoch', epochs=1) most of the
         delta mass would otherwise die with the client."""
         residual = getattr(self._codec, "residual", None)
-        if residual is not None and any(np.abs(r).max() > 0 for r in residual):
+        if residual is not None and any(
+            r.size and np.abs(r).max() > 0 for r in residual
+        ):
             if task_id is not None:
                 self._inner.update_parameters_tagged(task_id, residual)
             else:
