@@ -106,11 +106,18 @@ def main():
     y = np.eye(c, dtype="float32")[(x @ w).argmax(1)]
 
     # -- baseline: stock Keras-JAX fit on one device ----------------------
+    # Same best-of-N as the measured side below: the comparison must be
+    # symmetric or relay launch jitter would skew vs_baseline either way.
+    reps = int(os.environ.get("BENCH_REPS", 3))
     base_model = make_model(d, c)
     base_model.fit(x[:4096], y[:4096], epochs=1, batch_size=batch, verbose=0)  # warmup/compile
-    t0 = time.perf_counter()
-    base_model.fit(x, y, epochs=epochs, batch_size=batch, verbose=0, shuffle=True)
-    t_base = time.perf_counter() - t0
+    t_base = float("inf")
+    for rep in range(reps):
+        t0 = time.perf_counter()
+        base_model.fit(x, y, epochs=epochs, batch_size=batch, verbose=0, shuffle=True)
+        t_rep = time.perf_counter() - t0
+        log(f"baseline fit {rep}: {t_rep:.2f}s")
+        t_base = min(t_base, t_rep)
     base_sps = n * epochs / t_base
     log(f"keras baseline: {t_base:.2f}s -> {base_sps:,.0f} samples/sec (1 device)")
 
@@ -130,10 +137,17 @@ def main():
     # warmup: compile the whole-run program at the same geometry
     spark_model.fit(rdd, epochs=epochs, batch_size=batch, verbose=0,
                     validation_split=0.0)
-    t0 = time.perf_counter()
-    spark_model.fit(rdd, epochs=epochs, batch_size=batch, verbose=0,
-                    validation_split=0.0)
-    t_ours = time.perf_counter() - t0
+    # Measure several fits and keep the best: the relay-attached chip adds
+    # multi-second launch jitter that a single sample conflates with
+    # steady-state throughput (docs/PERFORMANCE.md records the spread).
+    t_ours = float("inf")
+    for rep in range(reps):
+        t0 = time.perf_counter()
+        spark_model.fit(rdd, epochs=epochs, batch_size=batch, verbose=0,
+                        validation_split=0.0)
+        t_rep = time.perf_counter() - t0
+        log(f"measured fit {rep}: {t_rep:.2f}s")
+        t_ours = min(t_ours, t_rep)
     ours_sps = n * epochs / t_ours
     ours_sps_chip = ours_sps / n_dev
     log(
