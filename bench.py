@@ -108,7 +108,7 @@ def main():
     # -- baseline: stock Keras-JAX fit on one device ----------------------
     # Same best-of-N as the measured side below: the comparison must be
     # symmetric or relay launch jitter would skew vs_baseline either way.
-    reps = int(os.environ.get("BENCH_REPS", 3))
+    reps = max(1, int(os.environ.get("BENCH_REPS", 3)))
     base_model = make_model(d, c)
     base_model.fit(x[:4096], y[:4096], epochs=1, batch_size=batch, verbose=0)  # warmup/compile
     t_base = float("inf")

@@ -416,7 +416,7 @@ class TransformerLM:
 
     def generate(self, params, prompt, n_new: int,
                  temperature: float = 0.0, top_k: Optional[int] = None,
-                 seed: int = 0):
+                 top_p: Optional[float] = None, seed: int = 0):
         """Autoregressive continuation: ``prompt`` ``[B, T0]`` int →
         ``[B, T0 + n_new]``. Single-device inference on full (gathered)
         params: one batched :meth:`prefill` over the prompt, then a
@@ -426,7 +426,10 @@ class TransformerLM:
         ``temperature=0`` (default) is greedy — for the dense model the
         output then equals the uncached argmax rollout exactly; ``>0``
         samples from ``softmax(logits / temperature)``, optionally
-        restricted to the ``top_k`` highest-probability tokens,
+        restricted to the ``top_k`` highest-probability tokens and/or the
+        nucleus of tokens whose cumulative probability reaches ``top_p``
+        (the most-probable token always survives; with both set, top-k
+        truncates first, then the nucleus is taken within it),
         deterministically per ``seed``. The MoE variant decodes too, with
         per-position routing (see :meth:`decode_step`)."""
         prompt = jnp.asarray(prompt, jnp.int32)
@@ -440,6 +443,8 @@ class TransformerLM:
             raise ValueError(
                 f"top_k must be in [1, vocab={self.vocab}], got {top_k}"
             )
+        if top_p is not None and not 0.0 < float(top_p) <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if n_new < 1:
             return prompt
 
@@ -450,6 +455,21 @@ class TransformerLM:
             if top_k is not None:
                 kth = jax.lax.top_k(logits, int(top_k))[0][:, -1:]
                 logits = jnp.where(logits >= kth, logits, -jnp.inf)
+            if top_p is not None and float(top_p) < 1.0:
+                # nucleus: smallest prefix of the sorted distribution whose
+                # mass reaches top_p. Tokens whose cumulative probability
+                # BEFORE them is already >= top_p are cut; the argmax token
+                # (cumulative-before = 0) always survives.
+                sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+                probs = jax.nn.softmax(sorted_logits, axis=-1)
+                cum_before = jnp.cumsum(probs, axis=-1) - probs
+                keep = cum_before < float(top_p)
+                # per-row threshold: smallest kept logit
+                thresh = jnp.min(
+                    jnp.where(keep, sorted_logits, jnp.inf),
+                    axis=-1, keepdims=True,
+                )
+                logits = jnp.where(logits >= thresh, logits, -jnp.inf)
             return jax.random.categorical(key, logits).astype(jnp.int32)
 
         key = jax.random.PRNGKey(seed)

@@ -110,6 +110,37 @@ def test_sampling_modes():
     np.testing.assert_array_equal(topk1, greedy)
 
 
+def test_nucleus_sampling():
+    """top_p→0 collapses to greedy (the argmax token always survives the
+    nucleus); top_p=1.0 is a no-op vs plain temperature sampling; draws
+    stay seed-deterministic and in-vocab."""
+    model = _model()
+    params = {k: jnp.asarray(v) for k, v in model.init(seed=4).items()}
+    prompt = np.array([[5, 6, 7], [1, 2, 3]], np.int32)
+
+    greedy = np.asarray(model.generate(params, prompt, n_new=6))
+    tiny_p = np.asarray(model.generate(params, prompt, n_new=6,
+                                       temperature=1.5, top_p=1e-6, seed=7))
+    np.testing.assert_array_equal(tiny_p, greedy)
+
+    plain = np.asarray(model.generate(params, prompt, n_new=6,
+                                      temperature=1.5, seed=7))
+    full_p = np.asarray(model.generate(params, prompt, n_new=6,
+                                       temperature=1.5, top_p=1.0, seed=7))
+    np.testing.assert_array_equal(full_p, plain)
+
+    a = np.asarray(model.generate(params, prompt, n_new=6,
+                                  temperature=1.5, top_p=0.8, seed=7))
+    b = np.asarray(model.generate(params, prompt, n_new=6,
+                                  temperature=1.5, top_p=0.8, seed=7))
+    np.testing.assert_array_equal(a, b)
+    assert np.all((a >= 0) & (a < 17))
+    # composes with top_k (top_k truncates first, nucleus inside it)
+    ck = np.asarray(model.generate(params, prompt, n_new=6, temperature=1.5,
+                                   top_k=5, top_p=0.9, seed=7))
+    assert np.all((ck >= 0) & (ck < 17))
+
+
 def test_generate_validates_length_and_top_k():
     model = _model(max_len=8)
     params = {k: jnp.asarray(v) for k, v in model.init().items()}
@@ -119,6 +150,10 @@ def test_generate_validates_length_and_top_k():
         with pytest.raises(ValueError, match="top_k"):
             model.generate(params, np.zeros((1, 2), np.int32), n_new=2,
                            temperature=1.0, top_k=bad)
+    for bad_p in (0.0, 1.5, -0.1):
+        with pytest.raises(ValueError, match="top_p"):
+            model.generate(params, np.zeros((1, 2), np.int32), n_new=2,
+                           temperature=1.0, top_p=bad_p)
 
 
 @pytest.mark.parametrize("ep_groups", [1, 4])
