@@ -91,6 +91,8 @@ class TransformerLM:
     ``seq_axis`` and are only valid under ``shard_map``.
     """
 
+    _supports_speculative = True
+
     def __init__(self, vocab: int, d_model: int, n_heads: int, n_layers: int,
                  d_ff: int, max_len: int, compute_dtype: str = "float32",
                  pos_encoding: str = "learned", tie_embeddings: bool = False,
@@ -414,6 +416,234 @@ class TransformerLM:
                         params["lnf_b"])
         return self._logits(params, h), {"k": kc_new, "v": vc_new}
 
+    def decode_chunk(self, params, tokens, pos0, cache):
+        """Cached forward over a BLOCK of ``S`` tokens at absolute positions
+        ``pos0..pos0+S-1`` → ``(logits [B, S, V] f32, new_cache)``.
+
+        The verification primitive for speculative decoding: the target
+        model scores all drafted positions in one matrix-matrix pass
+        instead of ``S`` sequential decode steps. Writes the chunk's K/V
+        into the cache first, then attends each query against cache
+        positions ``0..its own position`` — so a chunk starting at the
+        first stale cache position also *repairs* it (see
+        :meth:`generate_speculative`'s invariant). ``pos0`` may be traced.
+        Like :meth:`decode_step`, the MoE variant routes the chunk as its
+        own dispatch group."""
+        B, S = tokens.shape
+        H = self.n_heads
+        Hkv = self.n_kv_heads
+        Dh = self.d_model // H
+        cd = self.compute_dtype
+        T = cache["k"].shape[3]
+        positions = jnp.asarray(pos0) + jnp.arange(S)  # [S]
+        pos_b = jnp.broadcast_to(positions, (B, S))
+        h = self._embed(params, tokens, pos_b)  # [B, S, D]
+        rope = self._rope_for(pos_b)
+        # [S, T] causal-vs-cache mask: query i sees cache j <= pos0+i
+        mask = jnp.arange(T)[None, :] <= positions[:, None]
+
+        def block(h, inputs):
+            lp, kc, vc = inputs  # layer params; cache slices [B, Hkv, T, Dh]
+            x = _layer_norm(
+                h.astype(jnp.float32), lp["ln1_s"], lp["ln1_b"]
+            ).astype(cd)
+            q = (x @ lp["wq"].astype(cd)).reshape(B, S, H, Dh)
+            k_new = (x @ lp["wk"].astype(cd)).reshape(B, S, Hkv, Dh)
+            v_new = (x @ lp["wv"].astype(cd)).reshape(B, S, Hkv, Dh)
+            if rope is not None:
+                q = _rope_rotate(q, *rope)
+                k_new = _rope_rotate(k_new, *rope)
+            kc = jax.lax.dynamic_update_slice_in_dim(
+                kc, k_new.transpose(0, 2, 1, 3), pos0, axis=2)
+            vc = jax.lax.dynamic_update_slice_in_dim(
+                vc, v_new.transpose(0, 2, 1, 3), pos0, axis=2)
+            # grouped attention against the Hkv-head cache, all S queries
+            # at once (S is small — the dense [S, T] score block is cheap
+            # and hits the MXU as a matrix-matrix product)
+            qg = q.transpose(0, 2, 1, 3).reshape(B, Hkv, H // Hkv, S, Dh)
+            scores = jnp.einsum(
+                "bkgsd,bktd->bkgst", qg, kc,
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            ) * (Dh ** -0.5)
+            scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+            probs = jax.nn.softmax(scores, axis=-1)
+            a = jnp.einsum(
+                "bkgst,bktd->bkgsd", probs, vc,
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            ).astype(cd)
+            a = a.reshape(B, H, S, Dh).transpose(0, 2, 1, 3)
+            h = h + a.reshape(B, S, self.d_model) @ lp["wo"].astype(cd)
+            x = _layer_norm(
+                h.astype(jnp.float32), lp["ln2_s"], lp["ln2_b"]
+            ).astype(cd)
+            out, _ = self._ffn(lp, x, "dense", SEQ_AXIS, ep_groups=1)
+            return h + out.astype(cd), (kc, vc)
+
+        lps = {k: params[k] for k in self._block_keys()}
+        h, (kc_new, vc_new) = jax.lax.scan(
+            block, h, (lps, cache["k"], cache["v"])
+        )
+        h = _layer_norm(h.astype(jnp.float32), params["lnf_s"],
+                        params["lnf_b"])
+        return self._logits(params, h), {"k": kc_new, "v": vc_new}
+
+    def generate_speculative(self, params, prompt, n_new: int,
+                             draft: "TransformerLM", draft_params,
+                             spec_k: int = 4, temperature: float = 0.0,
+                             seed: int = 0):
+        """Speculative decoding (Leviathan/Chen et al.): a small ``draft``
+        model proposes ``spec_k`` tokens per round with cheap cached decode
+        steps; the target model scores all of them in ONE
+        :meth:`decode_chunk` pass and accepts a prefix. ``temperature=0``
+        accepts while the target's greedy choice matches the draft — the
+        output then EQUALS the target's own greedy :meth:`generate` exactly
+        (verified in tests); ``>0`` uses the standard rejection rule
+        (accept ``d`` w.p. ``min(1, p_t(d)/p_d(d))``, resample rejections
+        from ``(p_t − p_d)+``, bonus token from ``p_t``), which preserves
+        the target's sampling distribution.
+
+        Cache-staleness invariant: a rejected round leaves wrong K/V for
+        the rejected positions in BOTH caches, but every round's writes
+        start at the first such position and span far enough to repair all
+        of them before any query can attend there (chunk length
+        ``spec_k+1``, acceptance advances by at most ``n+1``).
+
+        Batch 1 only (per-row accept counts diverge); the draft shares the
+        target's vocabulary; proposals use plain temperature sampling
+        (no top-k/top-p). Latency-oriented: fewer sequential target steps
+        per emitted token at the cost of draft work — the win grows with
+        the target/draft size ratio.
+
+        Exactness caveat: "equals greedy generate" is bit-for-bit where the
+        verify and rollout paths share attention numerics (the CPU/einsum
+        path, which the tests pin). On TPU ``decode_step`` uses the
+        flash-decode kernel while ``decode_chunk`` uses a dense einsum; an
+        exact tie in the target's top-2 logits could in principle resolve
+        differently between them. Dense family only — the MoE variant's
+        chunked verification would route tokens as one competing dispatch
+        group while its rollout routes per-position, breaking the
+        equality, so it is rejected below."""
+        if not self._supports_speculative:
+            raise NotImplementedError(
+                "speculative decoding is supported for the dense "
+                "TransformerLM family only (MoE chunk routing differs "
+                "from its per-position decode routing)"
+            )
+        if not draft._supports_speculative:
+            raise NotImplementedError(
+                "the draft model must be a dense TransformerLM"
+            )
+        prompt = jnp.asarray(prompt, jnp.int32)
+        B, T0 = prompt.shape
+        if B != 1:
+            raise ValueError(
+                f"speculative decoding supports batch 1, got batch {B}"
+            )
+        if draft.vocab != self.vocab:
+            raise ValueError(
+                f"draft vocab {draft.vocab} != target vocab {self.vocab}"
+            )
+        if spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+        total = T0 + int(n_new)
+        if total > self.max_len or total > draft.max_len:
+            raise ValueError(
+                f"prompt {T0} + n_new {n_new} exceeds max_len "
+                f"(target {self.max_len}, draft {draft.max_len})"
+            )
+        if n_new < 1:
+            return prompt
+
+        horizon = total + spec_k + 1
+        t_logits, t_cache = self.prefill(params, prompt,
+                                         self.init_cache(1, horizon))
+        _, d_cache = draft.prefill(draft_params, prompt,
+                                   draft.init_cache(1, horizon))
+        rng = np.random.default_rng(seed)
+
+        def host_probs(logits_row):
+            x = np.asarray(logits_row, np.float64) / temperature
+            x -= x.max()
+            e = np.exp(x)
+            return e / e.sum()
+
+        def choose(logits_row):
+            if temperature <= 0.0:
+                return int(np.argmax(np.asarray(logits_row)))
+            return int(rng.choice(self.vocab, p=host_probs(logits_row)))
+
+        draft_step = jax.jit(draft.decode_step)
+        verify = jax.jit(self.decode_chunk)
+
+        out = list(np.asarray(prompt[0]))
+        carry = choose(t_logits[0, -1])
+        out.append(carry)
+        pos = T0  # absolute position of `carry`, not yet in either cache
+
+        while len(out) < total:
+            # -- draft spec_k proposals (cheap sequential steps) ----------
+            d_toks, d_probs = [], []
+            tok, p = carry, pos
+            for _ in range(spec_k):
+                dl, d_cache = draft_step(draft_params,
+                                         jnp.asarray([tok], jnp.int32),
+                                         p, d_cache)
+                if temperature > 0.0:
+                    row = host_probs(dl[0])
+                    tok = int(rng.choice(self.vocab, p=row))
+                    d_probs.append(row)
+                else:
+                    tok = int(np.argmax(np.asarray(dl[0])))
+                d_toks.append(tok)
+                p += 1
+
+            # -- target verifies the whole block in one pass --------------
+            chunk = jnp.asarray([[carry] + d_toks], jnp.int32)
+            vl, t_cache = verify(params, chunk, pos, t_cache)
+            vl = np.asarray(vl[0], np.float32)  # [spec_k+1, V]
+
+            if temperature <= 0.0:
+                t_arg = vl.argmax(axis=-1)
+                n = 0
+                while n < spec_k and int(t_arg[n]) == d_toks[n]:
+                    n += 1
+                emitted = d_toks[:n] + [int(t_arg[n])]
+            else:
+                n = 0
+                emitted = None
+                for i in range(spec_k):
+                    pt = host_probs(vl[i])
+                    pd = d_probs[i]
+                    d = d_toks[i]
+                    if rng.random() < min(1.0, pt[d] / max(pd[d], 1e-20)):
+                        n += 1
+                        continue
+                    resid = np.maximum(pt - pd, 0.0)
+                    z = resid.sum()
+                    resid = resid / z if z > 0 else pt
+                    emitted = d_toks[:n] + [int(rng.choice(self.vocab,
+                                                           p=resid))]
+                    break
+                if emitted is None:  # all accepted → bonus from the target
+                    emitted = d_toks + [int(rng.choice(
+                        self.vocab, p=host_probs(vl[spec_k])))]
+            if n == spec_k and len(emitted) == spec_k + 1:
+                # Full acceptance: the last draft token d_k was PROPOSED but
+                # never ingested by the draft (its K/V at position pos+k
+                # would stay a hole forever, corrupting later proposals and
+                # collapsing the acceptance rate). Ingest it now; the next
+                # round then starts at the bonus token's position.
+                _, d_cache = draft_step(draft_params,
+                                        jnp.asarray([d_toks[-1]], jnp.int32),
+                                        pos + spec_k, d_cache)
+            out.extend(emitted)
+            pos += len(emitted)
+            carry = emitted[-1]
+
+        return jnp.asarray([out[:total]], jnp.int32)
+
     def generate(self, params, prompt, n_new: int,
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  top_p: Optional[float] = None, seed: int = 0):
@@ -512,6 +742,8 @@ class MoETransformerLM(TransformerLM):
     the Switch load-balancing aux (weighted ``aux_weight``) enters the
     training objective.
     """
+
+    _supports_speculative = False  # chunk routing != per-position routing
 
     def __init__(self, vocab: int, d_model: int, n_heads: int, n_layers: int,
                  d_ff: int, max_len: int, n_experts: int, k: int = 2,
