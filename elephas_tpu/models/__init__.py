@@ -1,6 +1,12 @@
 from .adapters import KerasModelAdapter
 from .losses import resolve_accuracy, resolve_per_sample_loss
 from .optimizers import to_optax
+from .quantize import (
+    QuantizedTensor,
+    dequantize_params,
+    quantize_lm_params,
+    quantized_nbytes,
+)
 from .transformer import (
     SEQ_AXIS,
     MoETransformerLM,
@@ -13,6 +19,10 @@ from .transformer import (
 )
 
 __all__ = [
+    "QuantizedTensor",
+    "dequantize_params",
+    "quantize_lm_params",
+    "quantized_nbytes",
     "KerasModelAdapter",
     "resolve_per_sample_loss",
     "resolve_accuracy",

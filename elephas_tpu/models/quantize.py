@@ -1,0 +1,136 @@
+"""Weight-only int8 quantization for LM inference (TPU-native extension).
+
+Post-training, symmetric, per-channel: each matmul weight is stored as int8
+with one float32 scale per output channel (the token embedding per vocab
+row, so embedding lookups stay cheap). HBM-resident model size drops ~4×;
+dequantization happens lazily at each use site, so XLA converts/fuses the
+int8 operand on the way into the matmul instead of keeping a float copy of
+the whole model resident.
+
+Zero model-code changes: :func:`quantize_lm_params` returns the same params
+dict with the big weights replaced by :class:`QuantizedTensor` — a
+registered pytree node that dequantizes on ``astype``/``.T``/indexing/array
+conversion, the only operations the LM applies to its weights. Every use
+dequantizes to IDENTICAL float values, so ``generate(quantized)`` equals
+``generate(dequantized)`` bit-for-bit (pinned in tests); accuracy vs the
+original f32 weights is the usual ≤ scale/2 per-element quantization error.
+
+No reference (b13n3rd/elephas) analog: the reference has no quantization of
+any kind. Inference-oriented — training wants float weights (use this after
+training / checkpoint load).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# [*, in, out]-shaped matmul weights → scales on the last (output) axis.
+_LAST_AXIS_KEYS = ("wq", "wk", "wv", "wo", "w1", "w2", "head")
+# token embedding [V, D] → scales per vocab row (axis 0) so __getitem__
+# dequantizes only the gathered rows; ``tok.T`` (tied logits) then carries
+# per-output-channel scales, which is exactly the right layout there too.
+_ROW_AXIS_KEYS = ("tok",)
+
+
+@jax.tree_util.register_pytree_node_class
+class QuantizedTensor:
+    """int8 values + per-channel f32 scales; dequantizes lazily.
+
+    ``s`` is stored with the SAME rank as ``q`` (reduced axes kept as 1),
+    so plain broadcasting dequantizes and — crucially — ``lax.scan``
+    slicing a leading layer axis slices both leaves consistently.
+    ``row_scaled`` marks the embedding layout (scales per leading row),
+    whose ``__getitem__`` gathers before scaling.
+    """
+
+    def __init__(self, q, s, row_scaled: bool):
+        self.q = q
+        self.s = s
+        self.row_scaled = row_scaled
+
+    def tree_flatten(self):
+        return (self.q, self.s), self.row_scaled
+
+    @classmethod
+    def tree_unflatten(cls, row_scaled, children):
+        return cls(*children, row_scaled)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    @property
+    def nbytes(self):
+        return self.q.nbytes + self.s.nbytes
+
+    def dequantize(self, dtype=jnp.float32):
+        return (self.q.astype(jnp.float32) * self.s).astype(dtype)
+
+    # -- the operations the LM applies to its weights --------------------
+    def astype(self, dtype):
+        return self.dequantize(dtype)
+
+    def __jax_array__(self):
+        return self.dequantize()
+
+    @property
+    def T(self):
+        return self.dequantize().T
+
+    def __getitem__(self, idx):
+        if not self.row_scaled:
+            return self.dequantize()[idx]
+        # row-scaled (embedding) layout: gather rows, then scale only them
+        return self.q[idx].astype(jnp.float32) * self.s[idx]
+
+
+def quantize_lm_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Quantize a (dense-family) LM param dict for inference.
+
+    Matmul weights and the token embedding become :class:`QuantizedTensor`;
+    everything else (layernorm scales/biases, positional table, unknown
+    keys) passes through untouched — so partially-matching dicts (e.g. MoE
+    expert stacks) stay correct, just less compressed.
+    """
+    out: Dict[str, Any] = {}
+    for name, value in params.items():
+        ndim = np.ndim(value)
+        if name in _LAST_AXIS_KEYS and ndim >= 2:
+            # [*, in, out]: reduce the input axis only → one scale per
+            # (leading..., output channel), rank preserved for scan slicing
+            reduce_axis, row_scaled = -2, False
+        elif name in _ROW_AXIS_KEYS and ndim == 2:
+            reduce_axis, row_scaled = -1, True  # per vocab row
+        else:
+            out[name] = value  # untouched: no host round-trip
+            continue
+        v = np.asarray(value)
+        s = np.max(np.abs(v), axis=reduce_axis, keepdims=True)
+        s = np.maximum(s, 1e-12) / 127.0
+        q = np.clip(np.round(v / s), -127, 127).astype(np.int8)
+        out[name] = QuantizedTensor(
+            jnp.asarray(q), jnp.asarray(s.astype(np.float32)), row_scaled
+        )
+    return out
+
+
+def dequantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Materialize every :class:`QuantizedTensor` back to float32."""
+    return {
+        k: (v.dequantize() if isinstance(v, QuantizedTensor) else v)
+        for k, v in params.items()
+    }
+
+
+def quantized_nbytes(params: Dict[str, Any]) -> int:
+    return sum(
+        int(v.nbytes) for v in params.values()
+    )

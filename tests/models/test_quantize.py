@@ -1,0 +1,105 @@
+"""Weight-only int8 quantized inference vs the float model.
+
+The load-bearing property: every use site dequantizes to IDENTICAL float
+values, so running the model on quantized params must equal running it on
+the eagerly-dequantized params bit-for-bit — quantization error is then
+purely the (bounded, per-channel) weight rounding vs the ORIGINAL floats.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from elephas_tpu.models import (
+    QuantizedTensor,
+    TransformerLM,
+    dequantize_params,
+    quantize_lm_params,
+    quantized_nbytes,
+)
+
+
+def _model(**kw):
+    cfg = dict(vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64,
+               max_len=32)
+    cfg.update(kw)
+    return TransformerLM(**cfg)
+
+
+def _params(model, seed=0):
+    return {k: jnp.asarray(v) for k, v in model.init(seed=seed).items()}
+
+
+def test_roundtrip_error_bounded_and_size_shrinks():
+    model = _model()
+    params = _params(model)
+    qparams = quantize_lm_params(params)
+    for name in ("wq", "wo", "w1", "tok"):
+        orig = np.asarray(params[name])
+        deq = np.asarray(qparams[name].dequantize())
+        reduce_axis = -2 if name != "tok" else -1
+        scale = np.max(np.abs(orig), axis=reduce_axis, keepdims=True) / 127.0
+        assert np.all(np.abs(orig - deq) <= scale / 2 + 1e-7), name
+    # layernorm/bias params pass through untouched
+    assert not isinstance(qparams["ln1_s"], QuantizedTensor)
+    np.testing.assert_array_equal(qparams["ln1_s"], params["ln1_s"])
+    # weights dominate this model: int8 storage must be well under half
+    orig_bytes = sum(np.asarray(v).nbytes for v in params.values())
+    assert quantized_nbytes(qparams) < 0.45 * orig_bytes
+
+
+@pytest.mark.parametrize("kw", [
+    {},
+    {"pos_encoding": "rotary", "n_kv_heads": 2},
+    {"tie_embeddings": True},
+])
+def test_quantized_equals_dequantized_exactly(kw):
+    """apply / generate on QuantizedTensor params == on materialized
+    dequantized params, bit-for-bit (lazy dequant produces the same
+    floats at every use site, including through the layer scan)."""
+    model = _model(**kw)
+    params = _params(model, seed=1)
+    qparams = quantize_lm_params(params)
+    dparams = dequantize_params(qparams)
+
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 64, size=(2, 10)), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(10), (2, 10))
+    lq = np.asarray(model.apply(qparams, tokens, positions, attn="dense"))
+    ld = np.asarray(model.apply(dparams, tokens, positions, attn="dense"))
+    np.testing.assert_array_equal(lq, ld)
+
+    gq = np.asarray(model.generate(qparams, tokens[:, :4], n_new=8))
+    gd = np.asarray(model.generate(dparams, tokens[:, :4], n_new=8))
+    np.testing.assert_array_equal(gq, gd)
+
+
+def test_quantized_logits_close_to_float():
+    model = _model()
+    params = _params(model, seed=2)
+    qparams = quantize_lm_params(params)
+    rng = np.random.default_rng(1)
+    tokens = jnp.asarray(rng.integers(0, 64, size=(2, 12)), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(12), (2, 12))
+    lf = np.asarray(model.apply(params, tokens, positions, attn="dense"))
+    lq = np.asarray(model.apply(qparams, tokens, positions, attn="dense"))
+    # int8 per-channel keeps logits close; agreement is the real criterion
+    assert np.abs(lf - lq).max() < 0.15 * np.abs(lf).max()
+    agree = (lf.argmax(-1) == lq.argmax(-1)).mean()
+    assert agree > 0.9, agree
+
+
+def test_quantized_speculative_decoding_runs():
+    """Quantized target + quantized draft through the speculative path:
+    still exactly equal to the quantized target's own greedy rollout."""
+    target = _model()
+    t_q = quantize_lm_params(_params(target, seed=3))
+    draft = _model(d_model=16, n_heads=2, n_layers=1, d_ff=32)
+    d_q = quantize_lm_params(_params(draft, seed=4))
+    prompt = np.array([[1, 2, 3]], np.int32)
+    want = np.asarray(target.generate(t_q, prompt, n_new=8))
+    got = np.asarray(target.generate_speculative(
+        t_q, prompt, n_new=8, draft=draft, draft_params=d_q, spec_k=3,
+    ))
+    np.testing.assert_array_equal(got, want)
