@@ -95,13 +95,18 @@ class QuantizedTensor:
 def quantize_lm_params(params: Dict[str, Any]) -> Dict[str, Any]:
     """Quantize a (dense-family) LM param dict for inference.
 
-    Matmul weights and the token embedding become :class:`QuantizedTensor`;
-    everything else (layernorm scales/biases, positional table, unknown
-    keys) passes through untouched — so partially-matching dicts (e.g. MoE
-    expert stacks) stay correct, just less compressed.
+    Matmul weights and the token embedding become :class:`QuantizedTensor`
+    (including MoE expert stacks — their ``w1``/``w2`` are ``[L, E, in,
+    out]``, scaled per (layer, expert, output channel)); everything else
+    (layernorm scales/biases, positional table, unknown keys) passes
+    through untouched. Idempotent: an already-quantized dict passes
+    through unchanged.
     """
     out: Dict[str, Any] = {}
     for name, value in params.items():
+        if isinstance(value, QuantizedTensor):
+            out[name] = value
+            continue
         ndim = np.ndim(value)
         if name in _LAST_AXIS_KEYS and ndim >= 2:
             # [*, in, out]: reduce the input axis only → one scale per

@@ -878,7 +878,7 @@ def _check_seq_len(model: TransformerLM, sp: int, t: int) -> None:
 
 
 def build_lm_train_step(model: TransformerLM, mesh: Mesh, optimizer,
-                        attn: str = "ring"):
+                        attn: str = "ring", accum_steps: int = 1):
     """Compile one dp×sp (×ep for the MoE variant) LM training step.
 
     Returns ``(step, opt_init)``: ``step(params, opt_state, tokens,
@@ -891,7 +891,18 @@ def build_lm_train_step(model: TransformerLM, mesh: Mesh, optimizer,
     all_to_all transpose already delivered their gradients locally).
     ``loss`` is the optimized objective: token-mean CE plus the
     ``aux_weight``-scaled load-balancing term (zero for the dense model).
+
+    ``accum_steps > 1`` runs gradient accumulation: the local batch splits
+    into that many microbatches, a ``lax.scan`` accumulates their gradients,
+    and ONE optimizer step applies the sum — activation memory drops to one
+    microbatch's worth (the long-context lever that composes with remat and
+    sequence parallelism). For the dense model the accumulated step is
+    mathematically identical to the full-batch step (pinned in tests); the
+    MoE variant routes each microbatch as its own dispatch group, so its
+    routing (not its math) differs from whole-batch routing.
     """
+    if accum_steps < 1:
+        raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
     sp = _validate_lm_step(model, mesh, attn)
     from ..parallel.param_utils import opt_state_specs
 
@@ -919,17 +930,45 @@ def build_lm_train_step(model: TransformerLM, mesh: Mesh, optimizer,
         # ranks, so /(dp·sp) de-duplicates its sp copies).
         ntok_total = float(tokens.shape[0] * tokens.shape[1] * dp * sp)
 
-        def loss_fn(p):
-            logits, aux = model.apply_with_aux(
-                p, tokens, positions, attn=attn
-            )
+        def loss_fn(p, tk, ps, tg):
+            logits, aux = model.apply_with_aux(p, tk, ps, attn=attn)
             logp = jax.nn.log_softmax(logits, axis=-1)
-            ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+            ll = jnp.take_along_axis(logp, tg[..., None], axis=-1)[..., 0]
+            # per-microbatch pieces SUM to the full-batch objective:
+            # CE is normalized by the global token count, the aux term
+            # additionally by accum_steps (it is a per-call mean).
             return -jnp.sum(ll) / ntok_total + (
-                model.aux_weight / (dp * sp)
+                model.aux_weight / (dp * sp * accum_steps)
             ) * aux
 
-        objective, grads = jax.value_and_grad(loss_fn)(params)
+        if accum_steps == 1:
+            objective, grads = jax.value_and_grad(loss_fn)(
+                params, tokens, positions, targets
+            )
+        else:
+            B = tokens.shape[0]
+            if B % accum_steps:
+                raise ValueError(
+                    f"local batch {B} not divisible by accum_steps "
+                    f"{accum_steps}"
+                )
+            micro = B // accum_steps
+            split = lambda a: a.reshape(accum_steps, micro, *a.shape[1:])
+
+            def body(carry, xs):
+                obj_acc, grad_acc = carry
+                tk, ps, tg = xs
+                obj, g = jax.value_and_grad(loss_fn)(params, tk, ps, tg)
+                return (
+                    obj_acc + obj,
+                    jax.tree_util.tree_map(jnp.add, grad_acc, g),
+                ), None
+
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+            (objective, grads), _ = jax.lax.scan(
+                body, (jnp.zeros((), jnp.float32), zeros),
+                (split(tokens), split(positions), split(targets)),
+            )
         grads = {
             k: jax.lax.psum(
                 g if k in seq_sharded else jax.lax.psum(g, SEQ_AXIS),

@@ -90,6 +90,34 @@ def test_quantized_logits_close_to_float():
     assert agree > 0.9, agree
 
 
+def test_quantize_is_idempotent():
+    model = _model()
+    q1 = quantize_lm_params(_params(model))
+    q2 = quantize_lm_params(q1)
+    for k, v in q1.items():
+        assert q2[k] is v, k
+
+
+def test_moe_expert_stacks_quantize_and_stay_exact():
+    """MoE w1/w2 are [L, E, in, out]: quantized per (layer, expert,
+    channel); apply on quantized params == on dequantized params."""
+    from elephas_tpu.models.transformer import MoETransformerLM
+
+    moe = MoETransformerLM(vocab=32, d_model=16, n_heads=4, n_layers=1,
+                           d_ff=32, max_len=16, n_experts=4, k=1)
+    params = {k: jnp.asarray(v) for k, v in moe.init(seed=5).items()}
+    qparams = quantize_lm_params(params)
+    assert isinstance(qparams["w1"], QuantizedTensor)
+    assert qparams["w1"].s.shape == (1, 4, 1, 32)  # per (L, E, 1, out)
+    dparams = dequantize_params(qparams)
+    rng = np.random.default_rng(2)
+    tokens = jnp.asarray(rng.integers(0, 32, size=(2, 8)), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(8), (2, 8))
+    lq = np.asarray(moe.apply(qparams, tokens, positions, attn="dense"))
+    ld = np.asarray(moe.apply(dparams, tokens, positions, attn="dense"))
+    np.testing.assert_array_equal(lq, ld)
+
+
 def test_quantized_speculative_decoding_runs():
     """Quantized target + quantized draft through the speculative path:
     still exactly equal to the quantized target's own greedy rollout."""
