@@ -1,6 +1,14 @@
 from .adapters import KerasModelAdapter
 from .losses import resolve_accuracy, resolve_per_sample_loss
 from .optimizers import to_optax
+from .lora import (
+    LoRATensor,
+    apply_lora,
+    build_lora_lm_train_step,
+    lora_mask,
+    lora_trainable_count,
+    merge_lora,
+)
 from .quantize import (
     QuantizedTensor,
     dequantize_params,
@@ -19,6 +27,12 @@ from .transformer import (
 )
 
 __all__ = [
+    "LoRATensor",
+    "apply_lora",
+    "build_lora_lm_train_step",
+    "lora_mask",
+    "lora_trainable_count",
+    "merge_lora",
     "QuantizedTensor",
     "dequantize_params",
     "quantize_lm_params",

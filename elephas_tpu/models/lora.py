@@ -1,0 +1,252 @@
+"""LoRA fine-tuning for the LM family (TPU-native extension).
+
+Low-Rank Adaptation (Hu et al. 2021): freeze the pretrained weights, learn
+a rank-``r`` update ``ΔW = (α/r)·A·B`` per adapted matrix. Here the adapted
+entries of the params dict become :class:`LoRATensor` — a lazy pytree node
+that materializes ``W + (α/r)·A·B`` at each use site, with
+``stop_gradient`` on ``W`` so gradients reach ONLY the adapter factors.
+Model code is unchanged (same trick as ``quantize.py``); any gradient-based
+builder differentiates the right leaves automatically, and plain optimizers
+leave the frozen base untouched because its gradient is exactly zero
+(decay-style optimizers need :func:`lora_mask` — weight decay is not
+gradient-driven).
+
+``B`` initializes to zero, so the adapted model starts EXACTLY at the base
+model; :func:`merge_lora` bakes the learned update back into plain arrays
+for deployment (and composes with ``quantize_lm_params`` afterwards).
+
+No reference (b13n3rd/elephas) analog: the reference has no fine-tuning
+machinery of any kind.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .transformer import (
+    DATA_AXIS,
+    SEQ_AXIS,
+    Mesh,
+    P,
+    TransformerLM,
+)
+
+
+@jax.tree_util.register_pytree_node_class
+class LoRATensor:
+    """Frozen base ``w`` ``[*, in, out]`` + trainable ``a`` ``[*, in, r]``,
+    ``b`` ``[*, r, out]``; materializes ``w + (α/r)·a@b`` lazily. Leading
+    axes broadcast (layer stacks survive ``lax.scan`` slicing)."""
+
+    def __init__(self, w, a, b, alpha: float):
+        self.w = w
+        self.a = a
+        self.b = b
+        self.alpha = alpha
+
+    def tree_flatten(self):
+        return (self.w, self.a, self.b), self.alpha
+
+    @classmethod
+    def tree_unflatten(cls, alpha, children):
+        return cls(*children, alpha)
+
+    @property
+    def shape(self):
+        return self.w.shape
+
+    @property
+    def ndim(self):
+        return self.w.ndim
+
+    def materialize(self, dtype=jnp.float32):
+        rank = self.a.shape[-1]
+        delta = jnp.matmul(
+            self.a.astype(jnp.float32), self.b.astype(jnp.float32)
+        ) * (self.alpha / rank)
+        return (jax.lax.stop_gradient(self.w.astype(jnp.float32))
+                + delta).astype(dtype)
+
+    # -- the operations the LM applies to its weights --------------------
+    def astype(self, dtype):
+        return self.materialize(dtype)
+
+    def __jax_array__(self):
+        return self.materialize()
+
+    @property
+    def T(self):
+        return self.materialize().T
+
+    def __getitem__(self, idx):
+        return self.materialize()[idx]
+
+
+DEFAULT_LORA_KEYS = ("wq", "wv")
+
+
+def apply_lora(params: Dict[str, Any], keys: Sequence[str] = DEFAULT_LORA_KEYS,
+               rank: int = 8, alpha: float = 16.0,
+               seed: int = 0) -> Dict[str, Any]:
+    """Attach rank-``rank`` adapters to ``keys`` (default: the attention
+    q/v projections, the standard LoRA placement). ``A`` ~ N(0, 1/rank),
+    ``B`` = 0 — the adapted model starts exactly at the base."""
+    rng = np.random.default_rng(seed)
+    out: Dict[str, Any] = {}
+    for name, value in params.items():
+        if name not in keys:
+            out[name] = value
+            continue
+        if isinstance(value, LoRATensor):
+            if value.a.shape[-1] != rank or value.alpha != float(alpha):
+                raise ValueError(
+                    f"{name!r} already adapted with rank "
+                    f"{value.a.shape[-1]}/alpha {value.alpha}; re-applying "
+                    f"with rank {rank}/alpha {alpha} would silently keep "
+                    "the old adapters — merge_lora first to re-adapt"
+                )
+            out[name] = value  # idempotent for matching config
+            continue
+        w = jnp.asarray(value)
+        if w.ndim < 2:
+            raise ValueError(f"cannot adapt non-matrix param {name!r}")
+        *lead, d_in, d_out = w.shape
+        a = jnp.asarray(
+            rng.normal(size=(*lead, d_in, rank)).astype(np.float32)
+            / np.sqrt(rank)
+        )
+        b = jnp.zeros((*lead, rank, d_out), jnp.float32)
+        out[name] = LoRATensor(w, a, b, float(alpha))
+    missing = [k for k in keys if k not in params]
+    if missing:
+        raise ValueError(f"keys not in params: {missing}")
+    return out
+
+
+def merge_lora(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Bake adapters into plain float arrays (deployment form)."""
+    return {
+        k: (v.materialize() if isinstance(v, LoRATensor) else v)
+        for k, v in params.items()
+    }
+
+
+def lora_mask(params: Dict[str, Any]):
+    """Pytree of booleans (same structure as ``params``) — True on
+    trainable adapter factors, False on everything else, including each
+    adapter's frozen base. For ``optax.masked`` wrappers of decay-style
+    optimizers (weight decay is not gradient-driven, so ``stop_gradient``
+    alone does not protect the frozen base from it)."""
+    return {
+        k: (LoRATensor(False, True, True, v.alpha)
+            if isinstance(v, LoRATensor) else False)
+        for k, v in params.items()
+    }
+
+
+def lora_trainable_count(params: Dict[str, Any]) -> Tuple[int, int]:
+    """(trainable adapter element count, total element count)."""
+    trainable = total = 0
+    for v in params.values():
+        if isinstance(v, LoRATensor):
+            trainable += v.a.size + v.b.size
+            total += v.w.size + v.a.size + v.b.size
+        else:
+            total += np.size(v)
+    return trainable, total
+
+
+def build_lora_lm_train_step(model: TransformerLM, mesh: Mesh, optimizer,
+                             attn: str = "ring"):
+    """Compile a dp×sp fine-tuning step over a LoRA-adapted params dict.
+
+    Like :func:`~elephas_tpu.models.transformer.build_lm_train_step` but
+    the sharding specs are derived from the ACTUAL params pytree (adapter
+    nodes change its structure), everything replicated — the dense LM
+    family's layout; that structural difference is why this is a separate
+    builder (no ``accum_steps`` here — shrink the batch instead; adapter
+    grads are tiny). The optimizer is wrapped in ``optax.masked`` over
+    :func:`lora_mask`, so optimizer state exists ONLY for the adapter
+    factors (no full-model moment buffers for frozen weights) and
+    decay-style optimizers cannot touch the base; non-adapter gradients
+    are zeroed before the update as well.
+    """
+    import optax
+    from .transformer import _check_seq_len, _validate_lm_step
+
+    if not model._supports_speculative:  # reuse the dense-family marker
+        raise NotImplementedError(
+            "LoRA fine-tuning targets the dense TransformerLM family"
+        )
+    sp = _validate_lm_step(model, mesh, attn)
+    dp = mesh.shape[DATA_AXIS]
+    tok_spec = P(DATA_AXIS, SEQ_AXIS)
+
+    def replicated_like(tree):
+        return jax.tree_util.tree_map(lambda _: P(), tree)
+
+    def masked_optimizer(params):
+        return optax.masked(optimizer, lora_mask(params))
+
+    def make_step_impl(mask, opt):
+        def step_impl(params, opt_state, tokens, positions, targets):
+            ntok_total = float(tokens.shape[0] * tokens.shape[1] * dp * sp)
+
+            def loss_fn(p):
+                logits = model.apply(p, tokens, positions, attn=attn)
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                ll = jnp.take_along_axis(
+                    logp, targets[..., None], axis=-1
+                )[..., 0]
+                return -jnp.sum(ll) / ntok_total
+
+            objective, grads = jax.value_and_grad(loss_fn)(params)
+            # LoRA trains ONLY the adapter factors: zero every other
+            # gradient (the adapted bases are already zero via
+            # stop_gradient; the non-adapted params are zeroed here).
+            grads = jax.tree_util.tree_map(
+                lambda g, m: (
+                    jax.lax.psum(jax.lax.psum(g, SEQ_AXIS), DATA_AXIS)
+                    if m else jnp.zeros_like(g)
+                ),
+                grads, mask,
+            )
+            loss = jax.lax.psum(jax.lax.psum(objective, SEQ_AXIS), DATA_AXIS)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(jnp.add, params, updates)
+            return params, opt_state, loss
+
+        return step_impl
+
+    def build(params):
+        opt = masked_optimizer(params)
+        pspecs = replicated_like(params)
+        sspecs = replicated_like(jax.eval_shape(opt.init, params))
+        return jax.jit(
+            jax.shard_map(
+                make_step_impl(lora_mask(params), opt), mesh=mesh,
+                in_specs=(pspecs, sspecs, tok_spec, tok_spec, tok_spec),
+                out_specs=(pspecs, sspecs, P()),
+                check_vma=False,
+            ),
+            donate_argnums=(0, 1),
+        )
+
+    cache: Dict[Any, Any] = {}
+
+    def step(params, opt_state, tokens, positions, targets):
+        _check_seq_len(model, sp, tokens.shape[1])
+        key = jax.tree_util.tree_structure(params)
+        if key not in cache:
+            cache[key] = build(params)
+        return cache[key](params, opt_state, tokens, positions, targets)
+
+    def opt_init(params):
+        # masked init: moment buffers exist only for the adapter factors
+        return masked_optimizer(params).init(params)
+
+    return step, opt_init

@@ -1,0 +1,169 @@
+"""LoRA fine-tuning: exact start, frozen base, learned adapters, merge.
+
+Load-bearing properties: (1) B=0 init means the adapted model starts
+EXACTLY at the base model; (2) training moves ONLY the adapter factors —
+the frozen base is bit-identical after any number of steps; (3) merging
+bakes the adapters into plain arrays that reproduce the adapted model
+exactly.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from elephas_tpu.models import (
+    LoRATensor,
+    TransformerLM,
+    apply_lora,
+    build_lora_lm_train_step,
+    build_mesh_sp,
+    lora_mask,
+    lora_trainable_count,
+    make_lm_batches,
+    merge_lora,
+    quantize_lm_params,
+    shard_lm_batch,
+)
+
+
+def _model(sp=2, **kw):
+    cfg = dict(vocab=13, d_model=16, n_heads=sp, n_layers=2, d_ff=32,
+               max_len=8 * sp)
+    cfg.update(kw)
+    return TransformerLM(**cfg)
+
+
+def _params(model, seed=0):
+    return {k: jnp.asarray(v) for k, v in model.init(seed=seed).items()}
+
+
+def _batch(mesh, sp, rows=8, seed=0):
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 13, size=(rows, 8 * sp + 1))
+    return shard_lm_batch(mesh, *make_lm_batches(data))
+
+
+def test_adapted_model_starts_at_base():
+    model = _model()
+    base = _params(model, 1)
+    lparams = apply_lora(base, rank=4)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 13, size=(2, 8)), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(8), (2, 8))
+    lb = np.asarray(model.apply(base, tokens, positions, attn="dense"))
+    ll = np.asarray(model.apply(lparams, tokens, positions, attn="dense"))
+    np.testing.assert_array_equal(lb, ll)
+    trainable, total = lora_trainable_count(lparams)
+    assert 0 < trainable < 0.2 * total
+
+
+def test_training_moves_only_adapters_and_learns():
+    sp = 2
+    mesh = build_mesh_sp(data=2, seq=sp)
+    model = _model(sp)
+    lparams = apply_lora(_params(model, 1), rank=4)
+    step, opt_init = build_lora_lm_train_step(
+        model, mesh, optax.adam(5e-2), attn="ring"
+    )
+    state = opt_init(lparams)
+    # masked optimizer: moment buffers exist ONLY for adapter factors —
+    # no full-model state for frozen weights
+    trainable, total = lora_trainable_count(lparams)
+    state_elems = sum(
+        np.size(x) for x in jax.tree_util.tree_leaves(state)
+    )
+    assert state_elems <= 2 * trainable + 16, (state_elems, trainable)
+    batch = _batch(mesh, sp)
+    w_before = {k: np.asarray(v.w) for k, v in lparams.items()
+                if isinstance(v, LoRATensor)}
+    frozen_before = {k: np.asarray(v) for k, v in lparams.items()
+                     if not isinstance(v, LoRATensor)}
+    losses = []
+    for _ in range(8):
+        lparams, state, loss = step(lparams, state, *batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+    for k, w in w_before.items():
+        np.testing.assert_array_equal(np.asarray(lparams[k].w), w)
+        assert np.abs(np.asarray(lparams[k].b)).max() > 0  # adapters moved
+    for k, v in frozen_before.items():
+        np.testing.assert_array_equal(np.asarray(lparams[k]), v, err_msg=k)
+
+
+def test_merge_reproduces_adapted_model_and_quantizes():
+    sp = 2
+    mesh = build_mesh_sp(data=2, seq=sp)
+    model = _model(sp)
+    lparams = apply_lora(_params(model, 2), rank=4)
+    step, opt_init = build_lora_lm_train_step(
+        model, mesh, optax.adam(5e-2), attn="ring"
+    )
+    state = opt_init(lparams)
+    batch = _batch(mesh, sp, seed=3)
+    for _ in range(3):
+        lparams, state, _ = step(lparams, state, *batch)
+
+    merged = merge_lora(lparams)
+    assert not any(isinstance(v, LoRATensor) for v in merged.values())
+    rng = np.random.default_rng(4)
+    tokens = jnp.asarray(rng.integers(0, 13, size=(2, 10)), jnp.int32)
+    positions = jnp.broadcast_to(jnp.arange(10), (2, 10))
+    la = np.asarray(model.apply(lparams, tokens, positions, attn="dense"))
+    lm = np.asarray(model.apply(merged, tokens, positions, attn="dense"))
+    np.testing.assert_allclose(la, lm, atol=1e-5, rtol=1e-5)
+    # deployment composition: merged weights quantize like any others
+    q = quantize_lm_params(merged)
+    lq = np.asarray(model.apply(q, tokens, positions, attn="dense"))
+    assert np.isfinite(lq).all()
+
+
+def test_lora_mask_protects_base_from_weight_decay():
+    model = _model()
+    lparams = apply_lora(_params(model, 5), rank=2)
+    mask = lora_mask(lparams)
+    opt = optax.masked(optax.adamw(1e-2, weight_decay=0.5), mask)
+    state = opt.init(lparams)
+    zero_grads = jax.tree_util.tree_map(jnp.zeros_like, lparams)
+    updates, _ = opt.update(zero_grads, state, lparams)
+    flat_params = {k: v for k, v in lparams.items()}
+    # frozen leaves (incl. each adapter's base) get EXACTLY zero update
+    for k, v in flat_params.items():
+        u = updates[k]
+        if isinstance(v, LoRATensor):
+            np.testing.assert_array_equal(np.asarray(u.w), 0)
+        else:
+            np.testing.assert_array_equal(np.asarray(u), 0)
+
+
+def test_generate_works_through_adapters():
+    model = _model()
+    lparams = apply_lora(_params(model, 6), rank=2)
+    prompt = np.array([[1, 2, 3]], np.int32)
+    base_out = np.asarray(model.generate(_params(model, 6), prompt, n_new=6))
+    lora_out = np.asarray(model.generate(lparams, prompt, n_new=6))
+    np.testing.assert_array_equal(base_out, lora_out)  # B=0 → identical
+
+
+def test_validation():
+    model = _model()
+    params = _params(model)
+    with pytest.raises(ValueError, match="not in params"):
+        apply_lora(params, keys=("nope",))
+    with pytest.raises(ValueError, match="non-matrix"):
+        apply_lora(params, keys=("lnf_s",))
+    # idempotent for a matching config; mismatched re-adaptation raises
+    l1 = apply_lora(params, rank=2)
+    l2 = apply_lora(l1, rank=2)
+    assert l2["wq"] is l1["wq"]
+    with pytest.raises(ValueError, match="already adapted"):
+        apply_lora(l1, rank=8)
+    from elephas_tpu.models.transformer import MoETransformerLM
+
+    moe = MoETransformerLM(vocab=13, d_model=16, n_heads=2, n_layers=1,
+                           d_ff=32, max_len=16, n_experts=2, k=1)
+    mesh = build_mesh_sp(data=2, seq=2)
+    with pytest.raises(NotImplementedError, match="dense"):
+        build_lora_lm_train_step(moe, mesh, optax.adam(1e-2))
