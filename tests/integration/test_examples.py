@@ -24,6 +24,7 @@ EXAMPLES = [
     "hyperparam_optimization.py",
     "transformer_lm.py",
     "parallelism_tour.py",
+    "lm_inference_tour.py",
 ]
 
 
@@ -40,6 +41,7 @@ def test_example_runs(script):
         # skip-small-partitions quirk empties the fit
         "EX_SAMPLES": "2048",
         "EX_EPOCHS": "1",
+        "EX_STEPS": "12",
     })
     proc = subprocess.run(
         [sys.executable, os.path.join(_REPO, "examples", script)],
