@@ -5,9 +5,11 @@ from .lora import (
     LoRATensor,
     apply_lora,
     build_lora_lm_train_step,
+    load_lora,
     lora_mask,
     lora_trainable_count,
     merge_lora,
+    save_lora,
 )
 from .quantize import (
     QuantizedTensor,
@@ -30,6 +32,8 @@ __all__ = [
     "LoRATensor",
     "apply_lora",
     "build_lora_lm_train_step",
+    "load_lora",
+    "save_lora",
     "lora_mask",
     "lora_trainable_count",
     "merge_lora",

@@ -160,6 +160,47 @@ def lora_trainable_count(params: Dict[str, Any]) -> Tuple[int, int]:
     return trainable, total
 
 
+def save_lora(path: str, params: Dict[str, Any]) -> None:
+    """Persist ONLY the adapters (a tiny artifact — rank·(in+out) floats
+    per adapted matrix) as an npz; reattach to any copy of the base with
+    :func:`load_lora`. Full-state checkpointing of the whole adapted dict
+    also works through ``utils.save_pytree`` — this is the
+    share-the-fine-tune form."""
+    arrays: Dict[str, np.ndarray] = {}
+    for name, v in params.items():
+        if isinstance(v, LoRATensor):
+            arrays[f"{name}.a"] = np.asarray(v.a)
+            arrays[f"{name}.b"] = np.asarray(v.b)
+            arrays[f"{name}.alpha"] = np.float32(v.alpha)
+    if not arrays:
+        raise ValueError("no LoRA adapters in params")
+    np.savez(path, **arrays)
+
+
+def load_lora(path: str, base_params: Dict[str, Any]) -> Dict[str, Any]:
+    """Attach adapters saved by :func:`save_lora` onto ``base_params``
+    (plain float weights, e.g. a fresh checkpoint load of the pretrained
+    model). Shapes are validated against the base."""
+    if not str(path).endswith(".npz"):
+        path = str(path) + ".npz"
+    with np.load(path) as blob:
+        names = sorted({k.rsplit(".", 1)[0] for k in blob.files})
+        out = dict(base_params)
+        for name in names:
+            if name not in base_params:
+                raise ValueError(f"adapter {name!r} has no base param")
+            w = jnp.asarray(base_params[name])
+            a = jnp.asarray(blob[f"{name}.a"])
+            b = jnp.asarray(blob[f"{name}.b"])
+            if a.shape[:-1] != w.shape[:-1] or b.shape[-1] != w.shape[-1]:
+                raise ValueError(
+                    f"adapter {name!r} shaped {a.shape}x{b.shape} does not "
+                    f"fit base {w.shape}"
+                )
+            out[name] = LoRATensor(w, a, b, float(blob[f"{name}.alpha"]))
+    return out
+
+
 def build_lora_lm_train_step(model: TransformerLM, mesh: Mesh, optimizer,
                              attn: str = "ring"):
     """Compile a dp×sp fine-tuning step over a LoRA-adapted params dict.
