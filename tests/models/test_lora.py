@@ -180,6 +180,24 @@ def test_save_load_adapters_roundtrip(tmp_path):
         load_lora(path, bad_base)
 
 
+def test_full_state_checkpoint_of_adapted_params(tmp_path):
+    """The generic pytree checkpoint round-trips LoRATensor nodes (full
+    training state form, complementing the adapter-only save_lora)."""
+    from elephas_tpu.utils import load_pytree, save_pytree
+
+    model = _model()
+    lparams = apply_lora(_params(model, 13), rank=2)
+    path = str(tmp_path / "state")
+    save_pytree(path, lparams)
+    back = load_pytree(path)
+    assert isinstance(back["wq"], LoRATensor)
+    np.testing.assert_array_equal(np.asarray(back["wq"].w),
+                                  np.asarray(lparams["wq"].w))
+    np.testing.assert_array_equal(np.asarray(back["wq"].a),
+                                  np.asarray(lparams["wq"].a))
+    assert back["wq"].alpha == lparams["wq"].alpha
+
+
 def test_generate_works_through_adapters():
     model = _model()
     lparams = apply_lora(_params(model, 6), rank=2)
