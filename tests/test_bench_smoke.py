@@ -1,0 +1,37 @@
+"""bench.py smoke test: the driver-run benchmark must always produce its
+one JSON line, whatever happens to the internals it exercises."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_emits_json_line():
+    env = dict(os.environ)
+    env.update({
+        "PALLAS_AXON_POOL_IPS": "",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "KERAS_BACKEND": "jax",
+        "BENCH_NO_PROBE": "1",
+        "BENCH_SAMPLES": "4096",
+        "BENCH_EPOCHS": "1",
+        "BENCH_REPS": "1",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=420,
+    )
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    line = proc.stdout.strip().splitlines()[-1]
+    result = json.loads(line)
+    assert result["metric"] == "mnist_mlp_sync_samples_per_sec_per_chip"
+    assert result["unit"] == "samples/sec/chip"
+    assert result["value"] > 0
+    assert result["vs_baseline"] > 0
