@@ -19,7 +19,6 @@ device count, ``BENCH_SAMPLES``/``BENCH_EPOCHS`` to resize.
 
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -41,26 +40,15 @@ def ensure_backend_or_fallback(timeout_s: int = 420) -> None:
     """
     if os.environ.get("BENCH_NO_PROBE") or os.environ.get("BENCH_FELL_BACK"):
         return
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
-            timeout=timeout_s, capture_output=True, text=True,
-        )
-        if probe.returncode == 0:
-            log(f"backend probe ok: {probe.stdout.strip().splitlines()[-1]}")
-            return
-        log(f"backend probe failed (rc={probe.returncode}); falling back to CPU")
-        log(probe.stderr[-500:])
-    except subprocess.TimeoutExpired:
-        log(f"backend probe hung >{timeout_s}s; falling back to CPU")
-    env = dict(os.environ)
-    env.update({
-        "BENCH_FELL_BACK": "1",
-        "JAX_PLATFORMS": "cpu",
-        "PALLAS_AXON_POOL_IPS": "",
-        "XLA_FLAGS": env.get("XLA_FLAGS", "")
-        + " --xla_force_host_platform_device_count=8",
-    })
+    from harness_env import cpu_mesh_env, probe_backend
+
+    ok, n_visible, detail = probe_backend(timeout_s)
+    if ok:
+        log(f"backend probe ok: {n_visible} x {detail}")
+        return
+    log(f"backend probe failed ({detail}); falling back to CPU")
+    env = cpu_mesh_env(8)
+    env["BENCH_FELL_BACK"] = "1"
     os.execve(sys.executable, [sys.executable] + sys.argv, env)
 
 

@@ -1,0 +1,67 @@
+"""Shared backend-bootstrap helpers for the judged harness scripts.
+
+``bench.py`` and ``__graft_entry__.py`` both need the same two moves when the
+tunnelled TPU backend is absent, hung, or too small for the requested mesh:
+
+1. probe jax backend init in a *subprocess* (a hung ``jax.devices()`` through
+   a dead relay would otherwise hang the whole harness), and
+2. fall back to a virtual CPU mesh (``JAX_PLATFORMS=cpu`` + XLA's
+   ``--xla_force_host_platform_device_count``) so an artifact is always
+   produced.
+
+Keeping the recipe here — one importable module, no jax import at module
+scope — means the two harness entry points cannot drift apart.
+"""
+
+import os
+import subprocess
+import sys
+
+
+def cpu_mesh_env(n_devices, base_env=None):
+    """Return a copy of ``base_env`` (default ``os.environ``) rewritten to run
+    jax on a virtual ``n_devices``-device CPU mesh with axon TPU registration
+    disabled."""
+    env = dict(os.environ if base_env is None else base_env)
+    env.update(
+        {
+            "JAX_PLATFORMS": "cpu",
+            "PALLAS_AXON_POOL_IPS": "",
+            "KERAS_BACKEND": "jax",
+            "XLA_FLAGS": env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={int(n_devices)}",
+        }
+    )
+    return env
+
+
+def probe_backend(timeout_s=420):
+    """Initialize jax in a subprocess and report what it sees.
+
+    Returns ``(ok, n_devices, detail)`` where ``detail`` is the platform name
+    on success or a truncated error description on failure. Never raises and
+    never hangs past ``timeout_s``.
+    """
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import jax; d = jax.devices(); "
+                "print(len(d), d[0].platform)",
+            ],
+            timeout=timeout_s,
+            capture_output=True,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return False, 0, f"backend probe hung >{timeout_s}s"
+    if proc.returncode != 0:
+        return False, 0, proc.stderr[-500:]
+    # Plugins/sitecustomize may print extra lines around ours — scan from the
+    # end for the "<int> <platform>" line rather than trusting the last line.
+    for line in reversed(proc.stdout.strip().splitlines()):
+        parts = line.split()
+        if len(parts) == 2 and parts[0].isdigit():
+            return True, int(parts[0]), parts[1]
+    return False, 0, f"unparseable probe output: {proc.stdout[-200:]!r}"
