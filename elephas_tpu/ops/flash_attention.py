@@ -20,10 +20,12 @@ path (each head group holds the FULL sequence there, so its local attention
 is where ``[T, T]`` would otherwise appear); also usable standalone. The
 ring path needs nothing: its per-visit blocks are already ``T/P`` wide.
 
-Expressed in jnp rather than a hand-written Pallas kernel deliberately: the
-block bodies are a few matmuls + elementwise folds, which XLA fuses well on
-TPU, and the same code runs everywhere (CPU tests, interpret mode) with one
-source of truth.
+Two implementations, one contract: on TPU, :func:`flash_attention` routes
+to the hand-written Pallas kernels in ``pallas_flash.py`` (XLA does NOT
+fuse a ``lax.scan`` attention body into one kernel — measured ~10× off the
+matmul roofline at B8·H16·T2048 because every score tile round-trips HBM);
+everywhere else (CPU tests, oracles) it runs the jnp scan below, which is
+also the reference the Pallas kernels are tested against.
 
 Matmul precision: every attention einsum in the package pins
 ``Precision.HIGHEST``. On TPU the default would multiply in bf16 even for
@@ -223,7 +225,18 @@ def flash_attention(q, k, v, causal: bool = False, block_size: int = 128):
     size falls back to the largest divisor ≤ ``block_size``). Equals
     :func:`~elephas_tpu.ops.ring_attention.attention_reference` to float32
     accumulation, gradients included.
+
+    On TPU this dispatches to the fused Pallas kernels (``pallas_flash``),
+    which keep score tiles in VMEM and never broadcast the KV heads;
+    ``block_size`` then only applies to the jnp fallback (the kernels use
+    their own MXU-sized tiles).
     """
+    from .pallas_ops import is_tpu_backend
+
+    if is_tpu_backend():
+        from .pallas_flash import flash_attention_tpu
+
+        return flash_attention_tpu(q, k, v, causal)
     k = repeat_kv_heads(k, q.shape[2])
     v = repeat_kv_heads(v, q.shape[2])
     return _flash(q, k, v, causal, block_size)

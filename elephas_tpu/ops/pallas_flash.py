@@ -1,0 +1,401 @@
+"""Pallas flash-attention TRAINING kernels (forward + backward) for TPU.
+
+The pure-JAX blockwise implementation in ``flash_attention.py`` is exact but
+HBM-bound on TPU: XLA materializes every ``[T, block]`` score tile to HBM
+(measured ~14 ms/layer at B8·H16·T2048·Dh64 — ~10× the matmul-roofline
+time), because a ``lax.scan`` body is not fused into a single attention
+kernel. These kernels keep each score tile in VMEM for its whole life:
+one HBM read of Q/K/V per tile pair, no score/probability traffic at all.
+
+Layout convention — scores are computed K-MAJOR (``s^T: [bk, bq]``): the
+online-softmax statistics (running max ``m``, denominator ``l``, and the
+saved ``lse``) are then indexed by *query* position along the LANE axis,
+where cross-block broadcasts (``s^T - m``) are native sublane broadcasts.
+The output accumulator is kept transposed (``[Dh, bq]``) for the same
+reason; it is flipped once per query block at epilogue. This avoids every
+lane→sublane relayout in the hot loop.
+
+Grouped-query attention is native: K/V keep their ``Hkv`` heads and the
+BlockSpec index maps divide the query-head index (``h // G``) — the
+repeated heads are never materialized. Causality skips work at two levels:
+invisible tile pairs are skipped by ``pl.when`` AND their K/V DMAs never
+issue (the index map clamps to the last visible tile, the same trick as
+``flash_decode.py``).
+
+Backward follows FlashAttention-2: the forward saves only
+``lse = m + log l`` (``[B, H, T]``); ``Δ = Σ_d dO·O`` is precomputed in
+XLA (one fused elementwise+reduce). ``dq`` accumulates over KV tiles in
+one kernel; ``dk``/``dv`` accumulate over Q tiles in a second kernel with
+per-query-head partials summed across each GQA group outside.
+
+No reference (b13n3rd/elephas) analog: the reference has no attention ops
+at all (SURVEY.md §2) — this is TPU-first infrastructure for the LM family.
+Used via ``flash_attention`` (``flash_attention.py``), which routes here on
+TPU and to the scan implementation elsewhere; tests run these kernels in
+``interpret=True`` mode against the dense oracle, gradients included.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .pallas_ops import _pad_up
+
+_NEG = -1e30
+_BQ = 512
+_BK = 512
+
+
+def _prec(*refs):
+    """f32 inputs get HIGHEST (true f32 products — the package-wide rule,
+    see flash_attention.py); bf16 inputs are exact on the MXU either way."""
+    import jax
+    if any(r.dtype == jnp.float32 for r in refs):
+        return jax.lax.Precision.HIGHEST
+    return None
+
+
+def _visible(causal: bool, i, j, bq: int, bk: int):
+    """May query tile ``i`` see any of KV tile ``j``? (causal only)"""
+    if not causal:
+        return True
+    return j * bk <= i * bq + bq - 1
+
+
+def _mask_t(sT, causal: bool, i, j, bq: int, bk: int, t_true: int):
+    """Causal + length masking on a k-major ``[bk, bq]`` score tile.
+
+    Length masks apply only when T was padded up to the tile size. Padded
+    *query* rows must be masked too (not just sliced off after): backward
+    folds every row's ``p^T`` into dk/dv, so an unmasked garbage row would
+    corrupt real gradients.
+    """
+    keep = None
+    if causal:
+        kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, sT.shape, 0)
+        qpos = i * bq + jax.lax.broadcasted_iota(jnp.int32, sT.shape, 1)
+        keep = kpos <= qpos
+    if t_true % bk:
+        kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, sT.shape, 0)
+        m = kpos < t_true
+        keep = m if keep is None else keep & m
+    if t_true % bq:
+        qpos = i * bq + jax.lax.broadcasted_iota(jnp.int32, sT.shape, 1)
+        m = qpos < t_true
+        keep = m if keep is None else keep & m
+    return sT if keep is None else jnp.where(keep, sT, _NEG)
+
+
+# -- forward ------------------------------------------------------------------
+
+
+def _fwd_kernel(causal: bool, bq: int, bk: int, t_true: int, scale: float,
+                q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s):
+    from jax.experimental import pallas as pl
+
+    i, j = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_s[:] = jnp.full_like(m_s, _NEG)
+        l_s[:] = jnp.zeros_like(l_s)
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    @pl.when(_visible(causal, i, j, bq, bk))
+    def _compute():
+        q = q_ref[0, 0]                      # [bq, Dh]
+        k = k_ref[0, 0]                      # [bk, Dh]
+        prec = _prec(q_ref, k_ref)
+        sT = jax.lax.dot_general(            # k-major scores [bk, bq]
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        ) * scale
+        sT = _mask_t(sT, causal, i, j, bq, bk, t_true)
+        m_prev = m_s[:1]                     # [1, bq]
+        m_cur = jnp.maximum(m_prev, jnp.max(sT, axis=0, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)      # [1, bq]
+        p = jnp.exp(sT - m_cur)              # [bk, bq] f32
+        l_s[:1] = alpha * l_s[:1] + jnp.sum(p, axis=0, keepdims=True)
+        acc_s[:] = alpha * acc_s[:] + jax.lax.dot_general(
+            v_ref[0, 0], p.astype(v_ref.dtype),  # [Dh, bq] += v^T @ p
+            (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        )
+        m_s[:1] = m_cur
+
+    @pl.when(j == pl.num_programs(3) - 1)
+    def _finish():
+        l = jnp.maximum(l_s[:1], 1e-30)      # [1, bq]
+        o_ref[0, 0] = jnp.transpose(acc_s[:] / l).astype(o_ref.dtype)
+        # lse is stored [B, H, 8, T] (T on lanes): the 8 sublane copies are
+        # a free broadcast here and let every consumer read a lane-major
+        # [1, bq] row without relayout (TPU blocks need sublane dims % 8).
+        lse_ref[0, 0] = jnp.broadcast_to(m_s[:1] + jnp.log(l),
+                                         lse_ref[0, 0].shape)
+
+
+def _flash_fwd_tpu(q, k, v, causal, bq, bk, interpret):
+    """``q`` [B, H, T, Dh]; ``k``/``v`` [B, Hkv, T, Dh] → (o, lse)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, T, Dh = q.shape
+    Hkv = k.shape[1]
+    G = H // Hkv
+    bq, bk = min(bq, _pad_up(T, 8)), min(bk, _pad_up(T, 8))
+    Tq, Tk = _pad_up(T, bq), _pad_up(T, bk)
+    if Tq != T:
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, Tq - T), (0, 0)))
+    if Tk != T:
+        k = jnp.pad(k, ((0, 0), (0, 0), (0, Tk - T), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, 0), (0, Tk - T), (0, 0)))
+    nq, nk = Tq // bq, Tk // bk
+    scale = Dh ** -0.5
+
+    # Invisible KV tiles are never DMA'd: clamp their index to the last
+    # visible tile for this query tile (the compute is pl.when-skipped).
+    if causal:
+        kv_ix = lambda b, h, i, j: (
+            b, h // G, jnp.minimum(j, (i * bq + bq - 1) // bk), 0)
+    else:
+        kv_ix = lambda b, h, i, j: (b, h // G, j, 0)
+
+    grid = (B, H, nq, nk)
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, causal, bq, bk, T, scale),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, Dh), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, Dh), kv_ix),
+            pl.BlockSpec((1, 1, bk, Dh), kv_ix),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, Dh), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, 8, bq), lambda b, h, i, j: (b, h, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Tq, Dh), q.dtype),
+            jax.ShapeDtypeStruct((B, H, 8, Tq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((8, bq), jnp.float32),    # running max (row 0 live)
+            pltpu.VMEM((8, bq), jnp.float32),    # running denominator
+            pltpu.VMEM((Dh, bq), jnp.float32),   # transposed accumulator
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o[:, :, :T], lse[:, :, :, :T]
+
+
+# -- backward -----------------------------------------------------------------
+
+
+def _dq_kernel(causal: bool, bq: int, bk: int, t_true: int, scale: float,
+               q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref, dq_s):
+    from jax.experimental import pallas as pl
+
+    i, j = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_s[:] = jnp.zeros_like(dq_s)
+
+    @pl.when(_visible(causal, i, j, bq, bk))
+    def _compute():
+        q = q_ref[0, 0]                      # [bq, Dh]
+        k = k_ref[0, 0]                      # [bk, Dh]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]                    # [bq, Dh]
+        prec = _prec(q_ref, k_ref)
+        sT = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        ) * scale                            # [bk, bq]
+        sT = _mask_t(sT, causal, i, j, bq, bk, t_true)
+        pT = jnp.exp(sT - lse_ref[0, 0, :1])                  # [bk, bq]
+        dpT = jax.lax.dot_general(            # v @ do^T → [bk, bq]
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        )
+        dsT = pT * (dpT - dl_ref[0, 0, :1]) * scale
+        dq_s[:] += jax.lax.dot_general(       # k^T @ ds^T → [Dh, bq]
+            k, dsT.astype(k.dtype), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        )
+
+    @pl.when(j == pl.num_programs(3) - 1)
+    def _finish():
+        dq_ref[0, 0] = jnp.transpose(dq_s[:]).astype(dq_ref.dtype)
+
+
+def _dkv_kernel(causal: bool, bq: int, bk: int, t_true: int, scale: float,
+                q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+                dk_ref, dv_ref, dk_s, dv_s):
+    from jax.experimental import pallas as pl
+
+    j, i = pl.program_id(2), pl.program_id(3)   # KV tile outer, Q inner
+
+    @pl.when(i == 0)
+    def _init():
+        dk_s[:] = jnp.zeros_like(dk_s)
+        dv_s[:] = jnp.zeros_like(dv_s)
+
+    @pl.when(_visible(causal, i, j, bq, bk))
+    def _compute():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        prec = _prec(q_ref, k_ref)
+        sT = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        ) * scale                             # [bk, bq]
+        sT = _mask_t(sT, causal, i, j, bq, bk, t_true)
+        pT = jnp.exp(sT - lse_ref[0, 0, :1])
+        pTl = pT.astype(do.dtype)
+        dv_s[:] += jax.lax.dot_general(       # p^T @ do → [bk, Dh]
+            pTl, do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        )
+        dpT = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        )
+        dsT = pT * (dpT - dl_ref[0, 0, :1]) * scale
+        dk_s[:] += jax.lax.dot_general(       # ds^T @ q → [bk, Dh]
+            dsT.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=prec,
+        )
+
+    @pl.when(i == pl.num_programs(3) - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_s[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_s[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_tpu(q, k, v, o, lse, do, causal, bq, bk, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, T, Dh = q.shape
+    Hkv = k.shape[1]
+    G = H // Hkv
+    bq, bk = min(bq, _pad_up(T, 8)), min(bk, _pad_up(T, 8))
+    Tq, Tk = _pad_up(T, bq), _pad_up(T, bk)
+    # Δ in the same [B, H, 8, T] sublane-broadcast layout as lse.
+    delta = jnp.broadcast_to(
+        jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                axis=-1)[:, :, None, :],
+        lse.shape,
+    )
+    if Tq != T:
+        pad_q = ((0, 0), (0, 0), (0, Tq - T), (0, 0))
+        q, do = jnp.pad(q, pad_q), jnp.pad(do, pad_q)
+        # padded q rows: lse=0 and masked scores → p = exp(-1e30) = 0
+        lse = jnp.pad(lse, ((0, 0), (0, 0), (0, 0), (0, Tq - T)))
+        delta = jnp.pad(delta, ((0, 0), (0, 0), (0, 0), (0, Tq - T)))
+    if Tk != T:
+        pad_k = ((0, 0), (0, 0), (0, Tk - T), (0, 0))
+        k, v = jnp.pad(k, pad_k), jnp.pad(v, pad_k)
+    nq, nk = Tq // bq, Tk // bk
+    scale = Dh ** -0.5
+
+    if causal:
+        kv_ix = lambda b, h, i, j: (
+            b, h // G, jnp.minimum(j, (i * bq + bq - 1) // bk), 0)
+        # In the dkv kernel Q is the inner axis: clamp invisible (early)
+        # q tiles up to the first visible one.
+        q_ix = lambda b, h, j, i: (b, h, jnp.maximum(i, (j * bk) // bq), 0)
+        q_ix_s = lambda b, h, j, i: (b, h, 0, jnp.maximum(i, (j * bk) // bq))
+    else:
+        kv_ix = lambda b, h, i, j: (b, h // G, j, 0)
+        q_ix = lambda b, h, j, i: (b, h, i, 0)
+        q_ix_s = lambda b, h, j, i: (b, h, 0, i)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, causal, bq, bk, T, scale),
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, Dh), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, bk, Dh), kv_ix),
+            pl.BlockSpec((1, 1, bk, Dh), kv_ix),
+            pl.BlockSpec((1, 1, bq, Dh), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, 8, bq), lambda b, h, i, j: (b, h, 0, i)),
+            pl.BlockSpec((1, 1, 8, bq), lambda b, h, i, j: (b, h, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, Dh), lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, Tq, Dh), q.dtype),
+        scratch_shapes=[pltpu.VMEM((Dh, bq), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    # dk/dv per QUERY head; GQA groups summed below.
+    dkh, dvh = pl.pallas_call(
+        functools.partial(_dkv_kernel, causal, bq, bk, T, scale),
+        grid=(B, H, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, Dh), q_ix),
+            pl.BlockSpec((1, 1, bk, Dh), lambda b, h, j, i: (b, h // G, j, 0)),
+            pl.BlockSpec((1, 1, bk, Dh), lambda b, h, j, i: (b, h // G, j, 0)),
+            pl.BlockSpec((1, 1, bq, Dh), q_ix),
+            pl.BlockSpec((1, 1, 8, bq), q_ix_s),
+            pl.BlockSpec((1, 1, 8, bq), q_ix_s),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, Dh), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, bk, Dh), lambda b, h, j, i: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Tk, Dh), k.dtype),
+            jax.ShapeDtypeStruct((B, H, Tk, Dh), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, Dh), jnp.float32),
+            pltpu.VMEM((bk, Dh), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dq = dq[:, :, :T]
+    dkh, dvh = dkh[:, :, :T], dvh[:, :, :T]
+    if G > 1:
+        dkh = dkh.reshape(B, Hkv, G, T, Dh).sum(axis=2)
+        dvh = dvh.reshape(B, Hkv, G, T, Dh).sum(axis=2)
+    return dq, dkh.astype(k.dtype), dvh.astype(v.dtype)
+
+
+# -- custom-VJP wrapper (model layout [B, T, H, Dh]) --------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_tpu(q, k, v, causal: bool = False, block_q: int = _BQ,
+                        block_k: int = _BK, interpret: bool = False):
+    """Fused flash attention: ``q`` [B, T, H, Dh], ``k``/``v`` may carry
+    fewer (divisor) KV heads. Exact (online-softmax) attention; returns
+    [B, T, H, Dh] in ``q.dtype``."""
+    out, _ = _fa_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _fa_fwd(q, k, v, causal, block_q, block_k, interpret):
+    qt = jnp.swapaxes(q, 1, 2)   # [B, H, T, Dh]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    o, lse = _flash_fwd_tpu(qt, kt, vt, causal, block_q, block_k, interpret)
+    return jnp.swapaxes(o, 1, 2), (qt, kt, vt, o, lse)
+
+
+def _fa_bwd(causal, block_q, block_k, interpret, res, g):
+    qt, kt, vt, o, lse = res
+    do = jnp.swapaxes(g, 1, 2)
+    dq, dk, dv = _flash_bwd_tpu(qt, kt, vt, o, lse, do, causal,
+                                block_q, block_k, interpret)
+    return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
+            jnp.swapaxes(dv, 1, 2))
+
+
+flash_attention_tpu.defvjp(_fa_fwd, _fa_bwd)

@@ -37,7 +37,7 @@ def _data(b=4, t=32, vocab=17, seed=0):
 
 
 @pytest.mark.parametrize("attn,dp,sp", [("ring", 2, 4), ("ulysses", 2, 4),
-                                        ("ring", 1, 8)])
+                                        ("ring", 1, 8), ("flash", 4, 1)])
 def test_forward_matches_dense(attn, dp, sp):
     model = _model()
     params = {k: jnp.asarray(v) for k, v in model.init(seed=1).items()}
@@ -98,6 +98,52 @@ def test_train_step_matches_dense(attn):
             np.asarray(params[k]), np.asarray(v), rtol=5e-4, atol=5e-5,
             err_msg=k,
         )
+
+
+def test_flash_train_step_matches_dense():
+    """attn='flash' (blockwise custom-VJP kernel, dp-only mesh) takes the
+    same optimization trajectory as the dense oracle — gradients included."""
+    model = _model()
+    optimizer = optax.adam(1e-2)
+    tokens, positions, targets = _data()
+    params0 = model.init(seed=2)
+
+    o_params = {k: jnp.asarray(v) for k, v in params0.items()}
+    o_state = optimizer.init(o_params)
+    ntok = float(tokens.size)
+    o_losses = []
+    for _ in range(3):
+        def loss_fn(p):
+            return model.loss(p, tokens, positions, targets, attn="dense") / ntok
+        loss, grads = jax.value_and_grad(loss_fn)(o_params)
+        updates, o_state = optimizer.update(grads, o_state, o_params)
+        o_params = jax.tree_util.tree_map(jnp.add, o_params, updates)
+        o_losses.append(float(loss))
+
+    mesh = build_mesh_sp(data=4, seq=1)
+    step, opt_init = build_lm_train_step(model, mesh, optimizer, attn="flash")
+    params = model.shard_params(mesh, params0)
+    state = opt_init(params)
+    td, pd, gd = shard_lm_batch(mesh, tokens, positions, targets)
+    losses = []
+    for _ in range(3):
+        params, state, loss = step(params, state, td, pd, gd)
+        losses.append(float(loss))
+
+    np.testing.assert_allclose(losses, o_losses, rtol=2e-4, atol=2e-5)
+    for k, v in o_params.items():
+        np.testing.assert_allclose(
+            np.asarray(params[k]), np.asarray(v), rtol=5e-4, atol=5e-5,
+            err_msg=k,
+        )
+
+
+def test_flash_rejected_under_seq_axis():
+    mesh = build_mesh_sp(data=1, seq=8)
+    model = TransformerLM(vocab=10, d_model=16, n_heads=4, n_layers=1,
+                          d_ff=16, max_len=32)
+    with pytest.raises(ValueError, match="whole-sequence-per-shard"):
+        build_lm_train_step(model, mesh, optax.sgd(0.1), attn="flash")
 
 
 def test_learns_synthetic_task():
