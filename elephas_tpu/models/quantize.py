@@ -102,11 +102,21 @@ def quantize_lm_params(params: Dict[str, Any]) -> Dict[str, Any]:
     through untouched. Idempotent: an already-quantized dict passes
     through unchanged.
     """
+    from .lora import LoRATensor
+
     out: Dict[str, Any] = {}
     for name, value in params.items():
         if isinstance(value, QuantizedTensor):
             out[name] = value
             continue
+        if isinstance(value, LoRATensor):
+            # np.asarray on a LoRATensor yields a 0-d object array (it has
+            # __jax_array__ but not __array__), so the generic path below
+            # would die with an opaque TypeError. Be explicit instead.
+            raise ValueError(
+                f"param {name!r} is a LoRATensor adapter node — call "
+                "merge_lora(params) before quantize_lm_params"
+            )
         ndim = np.ndim(value)
         if name in _LAST_AXIS_KEYS and ndim >= 2:
             # [*, in, out]: reduce the input axis only → one scale per

@@ -53,6 +53,26 @@ def build_mesh_sp(data: Optional[int] = None, seq: int = 1, devices=None) -> Mes
     return build_mesh_2axis(SEQ_AXIS, data=data, second=seq, devices=devices)
 
 
+def nucleus_mask(logits, top_p: float):
+    """Boolean keep-mask of the top-p nucleus, per row of ``[B, V]`` logits.
+
+    The nucleus is the smallest prefix of the probability-sorted vocabulary
+    whose mass reaches ``top_p``; a token is kept iff the cumulative
+    probability BEFORE it is still < ``top_p`` (so the argmax always
+    survives). The mask is scattered back through the sort permutation —
+    NOT applied as a value threshold — so a boundary logit's duplicates
+    outside the prefix are cut by RANK; a value threshold would admit every
+    tie and silently widen the nucleus.
+    """
+    sort_ix = jnp.argsort(logits, axis=-1)[:, ::-1]
+    sorted_logits = jnp.take_along_axis(logits, sort_ix, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum_before = jnp.cumsum(probs, axis=-1) - probs
+    keep = cum_before < float(top_p)
+    rows = jnp.arange(logits.shape[0])[:, None]
+    return jnp.zeros(logits.shape, bool).at[rows, sort_ix].set(keep)
+
+
 def _summed_xent(logits, targets):
     """Summed next-token cross-entropy: ``-Σ (logit_at_target - logsumexp)``.
 
@@ -697,20 +717,9 @@ class TransformerLM:
                 kth = jax.lax.top_k(logits, int(top_k))[0][:, -1:]
                 logits = jnp.where(logits >= kth, logits, -jnp.inf)
             if top_p is not None and float(top_p) < 1.0:
-                # nucleus: smallest prefix of the sorted distribution whose
-                # mass reaches top_p. Tokens whose cumulative probability
-                # BEFORE them is already >= top_p are cut; the argmax token
-                # (cumulative-before = 0) always survives.
-                sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
-                probs = jax.nn.softmax(sorted_logits, axis=-1)
-                cum_before = jnp.cumsum(probs, axis=-1) - probs
-                keep = cum_before < float(top_p)
-                # per-row threshold: smallest kept logit
-                thresh = jnp.min(
-                    jnp.where(keep, sorted_logits, jnp.inf),
-                    axis=-1, keepdims=True,
+                logits = jnp.where(
+                    nucleus_mask(logits, float(top_p)), logits, -jnp.inf
                 )
-                logits = jnp.where(logits >= thresh, logits, -jnp.inf)
             return jax.random.categorical(key, logits).astype(jnp.int32)
 
         key = jax.random.PRNGKey(seed)

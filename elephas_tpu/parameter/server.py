@@ -52,7 +52,17 @@ class BaseParameterServer:
         self._running = False
         # task_id -> {"attempt": int, "delta": accumulated delta or None}.
         # Supports exactly-once retry semantics: see register_attempt.
+        # Insertion-ordered; bounded by _MAX_ATTEMPT_RECORDS (below).
         self._attempts: dict = {}
+
+    # Abandoned-record bound: task ids are stage-scoped (worker.py), so on a
+    # LONG-LIVED server every job that dies with retries exhausted leaves an
+    # uncommitted record pinning a model-sized accumulator forever. Evicting
+    # the oldest record past this cap bounds that growth. In-flight tasks of
+    # one fit never exceed the partition count, so a cap this size is only
+    # ever hit by garbage from dead jobs; an evicted task that nonetheless
+    # retries later just loses rollback (it re-registers from scratch).
+    _MAX_ATTEMPT_RECORDS = 512
 
     # -- weight ops ------------------------------------------------------
     def apply_delta(self, delta: List[np.ndarray],
@@ -106,6 +116,8 @@ class BaseParameterServer:
         with self.lock:
             prev = self._attempts.get(task_id)
             if prev is None:
+                while len(self._attempts) >= self._MAX_ATTEMPT_RECORDS:
+                    self._attempts.pop(next(iter(self._attempts)))
                 self._attempts[task_id] = {"attempt": int(attempt), "delta": None}
             elif int(attempt) > prev["attempt"]:
                 if prev["delta"] is not None:

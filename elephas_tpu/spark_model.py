@@ -395,12 +395,16 @@ class SparkModel:
                 # client per executor in the reference.
                 def run(iterator):
                     client = make_client()
-                    worker = AsynchronousSparkWorker(
-                        json_config, client, train_config, frequency,
-                        opt, loss, metrics, custom_objects,
-                    )
-                    yield from worker.train(iterator)
-                    client.close()
+                    try:
+                        worker = AsynchronousSparkWorker(
+                            json_config, client, train_config, frequency,
+                            opt, loss, metrics, custom_objects,
+                        )
+                        yield from worker.train(iterator)
+                    finally:
+                        # task retries re-enter run(): a raising attempt must
+                        # not leak its TCP connection until GC
+                        client.close()
 
                 return run
 
@@ -411,8 +415,10 @@ class SparkModel:
             )
             rdd.mapPartitions(fn).collect()
             client = self._make_client()
-            new_parameters = client.get_parameters()
-            client.close()
+            try:
+                new_parameters = client.get_parameters()
+            finally:
+                client.close()
             model.set_weights(new_parameters)
         finally:
             self.stop_server()

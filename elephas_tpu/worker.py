@@ -34,6 +34,17 @@ from .parameter.client import BaseParameterClient
 from .utils.functional_utils import subtract_params_np
 
 
+def task_id_for(ctx) -> str:
+    """Parameter-server task id for a :class:`~elephas_tpu.data.TaskContext`.
+
+    Stage-scoped, not just partition-scoped: against a long-lived external
+    server, an aborted prior job's uncommitted "partition-N" record would
+    otherwise mark a NEW job's attempt 0 as stale and silently disable
+    rollback for that task id. One format, shared with the tests.
+    """
+    return f"stage-{ctx.stageId()}-partition-{ctx.partitionId()}"
+
+
 def _materialize(data_iterator: Iterator) -> Optional[tuple]:
     """Partition iterator of ``(x, y)`` pairs → dense ``(x, y)`` arrays."""
     xs, ys = [], []
@@ -138,7 +149,7 @@ class AsynchronousSparkWorker:
         ctx = TaskContext.get()
         task_id = None
         if ctx is not None:
-            candidate = f"partition-{ctx.partitionId()}"
+            candidate = task_id_for(ctx)
             if self.client.register_attempt(candidate, ctx.attemptNumber()):
                 task_id = candidate
             elif ctx.attemptNumber() > 0:
@@ -149,8 +160,9 @@ class AsynchronousSparkWorker:
                 # which is the pre-retry behavior; resume via checkpoints).
                 raise RuntimeError(
                     "async task retry is not safe without the parameter "
-                    "server attempt API; aborting instead of double-applying "
-                    f"deltas (task {candidate}, attempt {ctx.attemptNumber()})"
+                    "server attempt API; aborting instead of double-applying"
+                    f" deltas (task {candidate}, attempt "
+                    f"{ctx.attemptNumber()})"
                 )
 
         def push(delta):

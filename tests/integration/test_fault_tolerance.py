@@ -35,7 +35,11 @@ def test_async_retry_rolls_back_partial_pushes(
         if ctx is not None and ctx.partitionId() == 0 and ctx.attemptNumber() == 0:
             # Simulate an executor that registers, pushes a *poison* partial
             # update, then dies. Rollback must erase the poison entirely.
-            tid = f"partition-{ctx.partitionId()}"
+            # the same stage-scoped id the real worker registers under —
+            # rollback only fires when the retry re-registers THIS id
+            from elephas_tpu.worker import task_id_for
+
+            tid = task_id_for(ctx)
             assert self.client.register_attempt(tid, ctx.attemptNumber())
             poison = [np.full_like(w, 1e6) for w in self.client.get_parameters()]
             self.client.update_parameters_tagged(tid, poison)

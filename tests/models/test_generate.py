@@ -167,3 +167,29 @@ def test_moe_variant_generates(ep_groups):
     out = model.generate(params, np.zeros((2, 3), np.int32), n_new=5)
     assert out.shape == (2, 8)
     assert np.all((np.asarray(out) >= 0) & (np.asarray(out) < 11))
+
+
+def test_nucleus_mask_cuts_tied_boundary_logits_by_rank():
+    """A value threshold would admit every duplicate of the boundary logit;
+    the mask must keep exactly the sorted prefix (argmax always survives)."""
+    from elephas_tpu.models.transformer import nucleus_mask
+
+    # row 0: probs ~ [0.5, 0.25, 0.25-eps...] with the two 0.25s TIED.
+    # top_p=0.7: prefix is {argmax, first 0.25}; the tied second 0.25 (and
+    # everything after) must be cut even though its logit equals the kept one.
+    logits = jnp.log(jnp.asarray([[0.5, 0.25, 0.25, 1e-9],
+                                  [0.97, 0.01, 0.01, 0.01]]))
+    keep = np.asarray(nucleus_mask(logits, 0.7))
+    # argmax kept, the tail cut, and EXACTLY ONE of the tied 0.25s kept
+    # (which one is sort-permutation detail; a value threshold would keep
+    # both and fail the xor)
+    assert keep[0, 0] and not keep[0, 3]
+    assert bool(keep[0, 1]) ^ bool(keep[0, 2])
+    # row 1: argmax alone already reaches 0.7 — nucleus is exactly {argmax}
+    assert keep[1].tolist() == [True, False, False, False]
+    # widening top_p widens the prefix (but the ~zero-mass tail token's
+    # cumulative-before is ~1.0, so it stays cut for any top_p < 1)
+    wide = np.asarray(nucleus_mask(logits, 0.99))
+    assert wide[0].tolist() == [True, True, True, False]
+    # (row 1's cumsum lands exactly ON 0.99 — an f32-rounding coin flip —
+    # so only the structurally unambiguous row is pinned here)

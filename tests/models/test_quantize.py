@@ -98,6 +98,26 @@ def test_quantize_is_idempotent():
         assert q2[k] is v, k
 
 
+def test_unmerged_lora_rejected_with_clear_error():
+    """A LoRATensor adapter node must raise 'merge_lora first', not an
+    opaque numpy TypeError from the 0-d object-array path."""
+    import pytest
+
+    from elephas_tpu.models.lora import LoRATensor
+
+    model = _model()
+    params = _params(model)
+    w = np.asarray(params["wq"], np.float32)
+    params["wq"] = LoRATensor(
+        w,
+        np.zeros((w.shape[0], w.shape[1], 2), np.float32),
+        np.zeros((w.shape[0], 2, w.shape[2]), np.float32),
+        alpha=4.0,
+    )
+    with pytest.raises(ValueError, match="merge_lora"):
+        quantize_lm_params(params)
+
+
 def test_moe_expert_stacks_quantize_and_stay_exact():
     """MoE w1/w2 are [L, E, in, out]: quantized per (layer, expert,
     channel); apply on quantized params == on dequantized params."""
