@@ -33,16 +33,49 @@ def load_native_library(lib_name: str,
         if lib is not None:
             return lib
         path = os.path.join(NATIVE_DIR, "build", lib_name)
-        if not os.path.exists(path):
+        # Always invoke make (a no-op when up to date): gating on the .so's
+        # existence would keep serving a stale library after source changes.
+        # An fcntl lock serializes concurrent PROCESSES (the module lock only
+        # covers threads); the Makefile also renames atomically, so a reader
+        # can never CDLL a half-written library.
+        import fcntl
+
+        build_dir = os.path.join(NATIVE_DIR, "build")
+        os.makedirs(build_dir, exist_ok=True)
+        with open(os.path.join(build_dir, ".lock"), "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
             proc = subprocess.run(
                 ["make", "-C", NATIVE_DIR], capture_output=True, text=True
             )
-            if proc.returncode != 0:
+        if proc.returncode != 0:
+            if not os.path.exists(path) or _stale(path):
+                # No library, or one older than the sources: loading would
+                # run code that no longer matches the tree. Fail loudly.
                 raise RuntimeError(
                     f"native build failed (make -C {NATIVE_DIR}):\n"
                     f"{proc.stderr[-2000:]}"
                 )
+            # Up-to-date .so + failed make (e.g. missing toolchain on a
+            # deployment box): usable, but say so.
+            import warnings
+
+            warnings.warn(
+                f"make -C {NATIVE_DIR} failed (rc={proc.returncode}); "
+                f"loading existing up-to-date {lib_name}",
+                RuntimeWarning,
+                stacklevel=2,
+            )
         lib = ctypes.CDLL(path)
         configure(lib)
         _libs[lib_name] = lib
         return lib
+
+
+def _stale(lib_path: str) -> bool:
+    """Is any native source newer than the built library?"""
+    lib_mtime = os.path.getmtime(lib_path)
+    for name in os.listdir(NATIVE_DIR):
+        if name.endswith((".cpp", ".h", ".cc")) or name == "Makefile":
+            if os.path.getmtime(os.path.join(NATIVE_DIR, name)) > lib_mtime:
+                return True
+    return False

@@ -45,9 +45,10 @@ class BaseParameterClient:
 
         Returns True if the server acknowledged the attempt API — callers
         should then push with :meth:`update_parameters_tagged`. The default
-        (and any client without the extension, e.g. the native binary
-        protocol) returns False: pushes stay untagged and retry semantics
-        degrade to the reference's (documented) at-least-once behavior.
+        (and any client talking to a server that predates the extension)
+        returns False: pushes stay untagged and retry semantics degrade to
+        the reference's (documented) at-least-once behavior. All three
+        shipped backends (http, socket, native) implement the extension.
         """
         return False
 

@@ -10,8 +10,16 @@ library is compiled on first use with the system ``g++`` (pybind11 is not in
 this environment — plain ``ctypes`` over an ``extern "C"`` API instead) and
 cached under ``native/build/``.
 
-Weights are handled as float32; non-float32 arrays are cast on the way in and
-restored to their original dtype on the way out.
+Weights are handled as float32 on the wire and in the store. Dtypes whose
+round-trip through float32 is lossless (float32, float16, bfloat16) are cast
+in and restored on the way out; precision-losing dtypes (float64, integers,
+bool) are rejected loudly at construction — silent f32 truncation of an
+optimizer's f64 state is exactly the class of bug a cast would hide.
+
+Exactly-once retry: the server implements the same R/T/C attempt extension
+as the Python servers, so :class:`NativeClient` supports ``register_attempt``
+/ ``update_parameters_tagged`` / ``commit_attempt`` and async task retry is
+rollback-safe on every backend (see ``parameter/server.py`` for semantics).
 """
 
 from __future__ import annotations
@@ -29,6 +37,20 @@ from .client import BaseParameterClient
 from ..native_build import load_native_library
 
 
+def check_f32_safe(dtypes) -> None:
+    """Reject dtypes the f32 store would silently truncate."""
+    for i, dt in enumerate(dtypes):
+        dt = np.dtype(dt) if not str(dt) == "bfloat16" else dt
+        name = str(dt)
+        if name in ("float32", "float16", "bfloat16"):
+            continue
+        raise ValueError(
+            f"native parameter server stores float32: array {i} has dtype "
+            f"{name}, whose values would be silently truncated — use "
+            "parameter_server_mode='http'/'socket' for non-f32 weights"
+        )
+
+
 def _configure(lib: ctypes.CDLL) -> None:
     lib.eps_create.restype = ctypes.c_void_p
     lib.eps_create.argtypes = [ctypes.c_int]
@@ -41,6 +63,8 @@ def _configure(lib: ctypes.CDLL) -> None:
     ]
     lib.eps_num_arrays.restype = ctypes.c_int
     lib.eps_num_arrays.argtypes = [ctypes.c_void_p]
+    lib.eps_attempt_count.restype = ctypes.c_int
+    lib.eps_attempt_count.argtypes = [ctypes.c_void_p]
     lib.eps_array_size.restype = ctypes.c_int64
     lib.eps_array_size.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.eps_get_array.argtypes = [
@@ -74,6 +98,7 @@ class NativeServer:
         self.port = int(port)
         self._shapes = [np.asarray(w).shape for w in weights]
         self._dtypes = [np.asarray(w).dtype for w in weights]
+        check_f32_safe(self._dtypes)
         self._set_weights(weights)
         self._running = False
 
@@ -106,6 +131,10 @@ class NativeServer:
             out.append(buf.reshape(self._shapes[i]).astype(self._dtypes[i]))
         return out
 
+    def attempt_count(self) -> int:
+        """Live exactly-once attempt records (bounded; see ps_server.cpp)."""
+        return int(self._lib.eps_attempt_count(self._handle))
+
     def stop(self) -> None:
         if self._handle is not None and self._running:
             self._lib.eps_stop(self._handle)
@@ -132,6 +161,7 @@ class NativeClient(BaseParameterClient):
     def __init__(self, shapes, dtypes, port: int, host: str = "127.0.0.1"):
         self.shapes = list(shapes)
         self.dtypes = list(dtypes)
+        check_f32_safe(self.dtypes)
         self.host = host
         self.port = int(port)
         self._sock: Optional[socket.socket] = None
@@ -144,7 +174,8 @@ class NativeClient(BaseParameterClient):
             self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return self._sock
 
-    def _read_exact(self, sock: socket.socket, n: int) -> bytes:
+    @staticmethod
+    def _read_exact(sock: socket.socket, n: int) -> bytes:
         chunks = []
         while n > 0:
             chunk = sock.recv(min(n, 1 << 20))
@@ -168,15 +199,88 @@ class NativeClient(BaseParameterClient):
                 out.append(buf.reshape(self.shapes[i]).astype(self.dtypes[i]))
             return out
 
-    def update_parameters(self, delta: List[np.ndarray]) -> None:
+    @staticmethod
+    def _delta_payload(delta: List[np.ndarray]) -> List[bytes]:
+        parts = [struct.pack("<I", len(delta))]
+        for d in delta:
+            flat = np.ascontiguousarray(d, dtype="<f4").ravel()
+            parts.append(struct.pack("<Q", flat.size))
+            parts.append(flat.tobytes())
+        return parts
+
+    def _push(self, header: List[bytes], delta: List[np.ndarray]) -> None:
         with self._lock:
             sock = self._ensure()
-            parts = [b"U", struct.pack("<I", len(delta))]
-            for d in delta:
-                flat = np.ascontiguousarray(d, dtype="<f4").ravel()
-                parts.append(struct.pack("<Q", flat.size))
-                parts.append(flat.tobytes())
-            sock.sendall(b"".join(parts))
+            sock.sendall(b"".join(header + self._delta_payload(delta)))
+            ack = self._read_exact(sock, 1)
+            if ack != b"A":
+                raise ConnectionError(f"native PS bad ack: {ack!r}")
+
+    def update_parameters(self, delta: List[np.ndarray]) -> None:
+        self._push([b"U"], delta)
+
+    @staticmethod
+    def _task_id_frame(task_id: str) -> List[bytes]:
+        raw = task_id.encode("utf-8")
+        return [struct.pack("<I", len(raw)), raw]
+
+    def register_attempt(self, task_id: str, attempt: int) -> bool:
+        with self._lock:
+            sock = self._ensure()
+            try:
+                sock.sendall(b"".join(
+                    [b"R"] + self._task_id_frame(task_id)
+                    + [struct.pack("<I", int(attempt))]
+                ))
+                ack = self._read_exact(sock, 1)
+            except socket.timeout:
+                # Slow server ≠ missing attempt API (it may have registered
+                # the attempt) — degrading to untagged pushes would reopen
+                # the double-apply hole. Let task retry handle it.
+                raise
+            except ConnectionError:
+                # Ambiguous: a pre-extension server drops unknown opcodes
+                # (indistinguishable from a reset), but so does a transient
+                # fault — and the server may ALREADY have registered the
+                # attempt. Disambiguate with a fresh-connection liveness
+                # probe: a server that answers a plain GET but dropped 'R'
+                # is pre-extension (degrade to untagged); an unreachable
+                # one is a transient fault (re-raise — degrading would
+                # reopen the double-apply hole; task retry handles it).
+                try:
+                    sock.close()
+                finally:
+                    self._sock = None
+                probe = socket.create_connection(
+                    (self.host, self.port), timeout=30
+                )
+                try:
+                    probe.sendall(b"G")
+                    n = struct.unpack("<I", self._read_exact(probe, 4))[0]
+                    for _ in range(n):
+                        (nelem,) = struct.unpack(
+                            "<Q", self._read_exact(probe, 8)
+                        )
+                        self._read_exact(probe, int(nelem) * 4)
+                finally:
+                    probe.close()
+                return False
+            if ack != b"k":
+                try:
+                    sock.close()
+                finally:
+                    self._sock = None
+                return False
+        return True
+
+    def update_parameters_tagged(self, task_id: str,
+                                 delta: List[np.ndarray]) -> None:
+        self._push([b"T"] + self._task_id_frame(task_id), delta)
+
+    def commit_attempt(self, task_id: str) -> None:
+        with self._lock:
+            sock = self._ensure()
+            sock.sendall(b"".join([b"C"] + self._task_id_frame(task_id)))
             ack = self._read_exact(sock, 1)
             if ack != b"A":
                 raise ConnectionError(f"native PS bad ack: {ack!r}")

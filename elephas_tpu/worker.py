@@ -153,7 +153,7 @@ class AsynchronousSparkWorker:
             if self.client.register_attempt(candidate, ctx.attemptNumber()):
                 task_id = candidate
             elif ctx.attemptNumber() > 0:
-                # No attempt API (e.g. native binary protocol): a retry here
+                # No attempt API (a pre-extension remote server): a retry here
                 # would re-push on top of the failed attempt's deltas — the
                 # exact double-apply hole tagged pushes exist to close. Fail
                 # fast instead (the job aborts once attempts are exhausted,

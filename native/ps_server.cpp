@@ -11,6 +11,19 @@
 //   'G'                                   -> reply: u32 n_arrays, then per
 //                                            array u64 nelem + nelem*f32
 //   'U' u32 n_arrays { u64 nelem, f32[] } -> weights[i] -= delta[i]; reply 'A'
+//   'R' u32 len, id[], u32 attempt        -> register task attempt; reply 'k'
+//   'T' u32 len, id[], <U payload>        -> tagged update (accumulated
+//                                            under the task record); reply 'A'
+//   'C' u32 len, id[]                     -> commit (drop record); reply 'A'
+//
+// The R/T/C opcodes are the exactly-once retry extension, mirroring the
+// Python servers (elephas_tpu/parameter/server.py register_attempt /
+// commit_attempt): a task's tagged pushes accumulate under its record; when
+// a NEWER attempt of the same task registers, the failed attempt's whole
+// accumulated contribution is rolled back (weights += acc) before the retry
+// pushes anything. Stale/duplicate registers are ignored. Abandoned records
+// are bounded (oldest evicted past kMaxAttemptRecords) so dead jobs on a
+// long-lived server cannot pin model-sized accumulators forever.
 //
 // Exposed through a minimal C API consumed via ctypes
 // (elephas_tpu/parameter/native.py). Build: native/Makefile (g++ -O3
@@ -26,23 +39,93 @@
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
+#include <deque>
 #include <mutex>
+#include <string>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 namespace {
+
+constexpr size_t kMaxAttemptRecords = 512;
+
+struct AttemptRec {
+  uint32_t attempt = 0;
+  bool has_delta = false;
+  std::vector<std::vector<float>> delta;  // sum of this attempt's pushes
+};
 
 struct WeightStore {
   std::vector<std::vector<float>> arrays;
   std::mutex mu;
   bool hogwild = false;
+  std::unordered_map<std::string, AttemptRec> attempts;
+  std::deque<std::string> attempt_order;  // insertion order, for eviction
 
-  void apply_delta(const std::vector<std::vector<float>>& delta) {
-    if (hogwild) {
+  void apply_delta(const std::vector<std::vector<float>>& delta,
+                   const std::string* task_id = nullptr) {
+    if (hogwild && task_id == nullptr) {
       subtract(delta);  // racy by design: HOGWILD! semantics
     } else {
+      // Tagged pushes always lock: the accumulator bookkeeping must not
+      // race (hogwild's weight write staying best-effort is about the
+      // weights, not the control-plane records).
       std::lock_guard<std::mutex> lock(mu);
       subtract(delta);
+      if (task_id != nullptr) {
+        auto it = attempts.find(*task_id);
+        if (it != attempts.end()) {
+          if (!it->second.has_delta) {
+            it->second.delta = delta;
+            it->second.has_delta = true;
+          } else {
+            auto& acc = it->second.delta;
+            for (size_t i = 0; i < acc.size() && i < delta.size(); ++i) {
+              const size_t n = std::min(acc[i].size(), delta[i].size());
+              for (size_t j = 0; j < n; ++j) acc[i][j] += delta[i][j];
+            }
+          }
+        }
+      }
+    }
+  }
+
+  // Mirrors the Python server's register_attempt: rollback on a newer
+  // attempt, ignore stale registers, bound abandoned records.
+  void register_attempt(const std::string& task_id, uint32_t attempt) {
+    std::lock_guard<std::mutex> lock(mu);
+    auto it = attempts.find(task_id);
+    if (it == attempts.end()) {
+      while (attempts.size() >= kMaxAttemptRecords && !attempt_order.empty()) {
+        attempts.erase(attempt_order.front());
+        attempt_order.pop_front();
+      }
+      attempts[task_id] = AttemptRec{attempt, false, {}};
+      attempt_order.push_back(task_id);
+    } else if (attempt > it->second.attempt) {
+      if (it->second.has_delta) {
+        for (size_t i = 0;
+             i < arrays.size() && i < it->second.delta.size(); ++i) {
+          float* w = arrays[i].data();
+          const float* d = it->second.delta[i].data();
+          const size_t n = std::min(arrays[i].size(),
+                                    it->second.delta[i].size());
+          for (size_t j = 0; j < n; ++j) w[j] += d[j];
+        }
+      }
+      it->second = AttemptRec{attempt, false, {}};
+    }  // else: stale/duplicate — keep the live attempt record
+  }
+
+  void commit_attempt(const std::string& task_id) {
+    std::lock_guard<std::mutex> lock(mu);
+    attempts.erase(task_id);
+    for (auto it = attempt_order.begin(); it != attempt_order.end(); ++it) {
+      if (*it == task_id) {
+        attempt_order.erase(it);
+        break;
+      }
     }
   }
 
@@ -131,6 +214,14 @@ bool write_weight_lists(int fd, const std::vector<std::vector<float>>& arrays) {
   return true;
 }
 
+bool read_task_id(int fd, std::string* out, const std::atomic<bool>* running) {
+  uint32_t len = 0;
+  if (!read_exact(fd, &len, sizeof(len), running)) return false;
+  if (len > 4096) return false;  // sanity bound
+  out->resize(len);
+  return read_exact(fd, out->data(), len, running);
+}
+
 void serve_connection(Server* s, int fd) {
   int one = 1;
   ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
@@ -146,6 +237,28 @@ void serve_connection(Server* s, int fd) {
       std::vector<std::vector<float>> delta;
       if (!read_weight_lists(fd, &delta, &s->running)) break;
       s->store.apply_delta(delta);
+      char ack = 'A';
+      if (!write_exact(fd, &ack, 1)) break;
+    } else if (op == 'R') {
+      std::string task_id;
+      uint32_t attempt = 0;
+      if (!read_task_id(fd, &task_id, &s->running)) break;
+      if (!read_exact(fd, &attempt, sizeof(attempt), &s->running)) break;
+      s->store.register_attempt(task_id, attempt);
+      char ack = 'k';
+      if (!write_exact(fd, &ack, 1)) break;
+    } else if (op == 'T') {
+      std::string task_id;
+      if (!read_task_id(fd, &task_id, &s->running)) break;
+      std::vector<std::vector<float>> delta;
+      if (!read_weight_lists(fd, &delta, &s->running)) break;
+      s->store.apply_delta(delta, &task_id);
+      char ack = 'A';
+      if (!write_exact(fd, &ack, 1)) break;
+    } else if (op == 'C') {
+      std::string task_id;
+      if (!read_task_id(fd, &task_id, &s->running)) break;
+      s->store.commit_attempt(task_id);
       char ack = 'A';
       if (!write_exact(fd, &ack, 1)) break;
     } else {
@@ -209,6 +322,14 @@ void eps_set_weights(void* handle, int n_arrays, const int64_t* sizes,
   for (int i = 0; i < n_arrays; ++i) {
     s->store.arrays[i].assign(data[i], data[i] + sizes[i]);
   }
+}
+
+// Live attempt-record count (testability: the Python servers expose their
+// dict directly; this is the C++ store's equivalent).
+int eps_attempt_count(void* handle) {
+  auto* s = static_cast<Server*>(handle);
+  std::lock_guard<std::mutex> lock(s->store.mu);
+  return static_cast<int>(s->store.attempts.size());
 }
 
 int eps_num_arrays(void* handle) {

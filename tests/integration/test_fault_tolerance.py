@@ -19,8 +19,20 @@ from elephas_tpu.worker import AsynchronousSparkWorker, SparkWorker
 pytestmark = pytest.mark.slow
 
 
+def _ps_backends():
+    from elephas_tpu.parameter.native import native_available
+
+    return [
+        "http", "socket",
+        pytest.param("native", marks=pytest.mark.skipif(
+            not native_available(), reason="native toolchain unavailable")),
+    ]
+
+
+@pytest.mark.parametrize("ps_mode", _ps_backends())
 def test_async_retry_rolls_back_partial_pushes(
-    spark_context, toy_classification, classifier_factory, monkeypatch
+    spark_context, toy_classification, classifier_factory, monkeypatch,
+    ps_mode,
 ):
     x, y = toy_classification
     rdd = to_simple_rdd(spark_context, x, y, num_slices=2)
@@ -51,7 +63,7 @@ def test_async_retry_rolls_back_partial_pushes(
 
     spark_model = SparkModel(
         model, mode="asynchronous", frequency="epoch",
-        parameter_server_mode="http", num_workers=2, port=0,
+        parameter_server_mode=ps_mode, num_workers=2, port=0,
     )
     spark_model.fit(rdd, epochs=2, batch_size=32, verbose=0, validation_split=0.0)
 
@@ -69,8 +81,8 @@ def test_async_retry_rolls_back_partial_pushes(
 def test_async_retry_without_attempt_api_fails_fast(
     spark_context, toy_classification, classifier_factory, monkeypatch
 ):
-    """Clients without the attempt API (native binary protocol) must not
-    silently double-apply under retry — the retried attempt aborts instead."""
+    """Clients without the attempt API (a pre-extension remote server) must
+    not silently double-apply under retry — the retried attempt aborts."""
     from elephas_tpu.data import TaskFailedError
     from elephas_tpu.parameter.client import HttpClient
 

@@ -11,24 +11,47 @@ import numpy as np
 import pytest
 
 from elephas_tpu.parameter.client import BaseParameterClient
+from elephas_tpu.parameter.native import native_available
 from elephas_tpu.parameter.server import HttpServer, SocketServer
 
 W0 = [np.zeros((3,), dtype="float64"), np.full((2, 2), 10.0)]
 
+BACKENDS = [
+    "http",
+    "socket",
+    pytest.param("native", marks=pytest.mark.skipif(
+        not native_available(), reason="native toolchain unavailable")),
+]
 
-def start(server_cls, mode="asynchronous"):
+
+def start(kind, mode="asynchronous"):
+    if kind == "native":
+        # the native store is f32-only by contract (it rejects f64 loudly)
+        from elephas_tpu.parameter.native import NativeClient, NativeServer
+
+        w0 = [w.astype("float32") for w in W0]
+        server = NativeServer([w.copy() for w in w0], mode=mode, port=0)
+        server.start()
+        client = NativeClient([w.shape for w in w0],
+                              ["float32"] * len(w0), port=server.port)
+        return server, client
+    server_cls = {"http": HttpServer, "socket": SocketServer}[kind]
     server = server_cls([w.copy() for w in W0], mode=mode, port=0)
     server.start()
-    kind = "http" if server_cls is HttpServer else "socket"
     client = BaseParameterClient.get_client(kind, port=server.port, host="127.0.0.1")
     return server, client
+
+
+def attempt_count(server) -> int:
+    return (server.attempt_count() if hasattr(server, "attempt_count")
+            else len(server._attempts))
 
 
 def delta(v):
     return [np.full((3,), v), np.full((2, 2), v)]
 
 
-@pytest.mark.parametrize("server_cls", [HttpServer, SocketServer])
+@pytest.mark.parametrize("server_cls", BACKENDS)
 def test_retry_rolls_back_failed_attempt(server_cls):
     server, client = start(server_cls)
     try:
@@ -47,7 +70,7 @@ def test_retry_rolls_back_failed_attempt(server_cls):
         server.stop()
 
 
-@pytest.mark.parametrize("server_cls", [HttpServer, SocketServer])
+@pytest.mark.parametrize("server_cls", BACKENDS)
 def test_untagged_updates_keep_reference_behavior(server_cls):
     """Plain reference-shaped pushes are untouched by the attempt machinery."""
     server, client = start(server_cls)
@@ -61,7 +84,7 @@ def test_untagged_updates_keep_reference_behavior(server_cls):
         server.stop()
 
 
-@pytest.mark.parametrize("server_cls", [HttpServer, SocketServer])
+@pytest.mark.parametrize("server_cls", BACKENDS)
 def test_independent_tasks_do_not_roll_back_each_other(server_cls):
     server, client = start(server_cls)
     try:
@@ -79,7 +102,7 @@ def test_independent_tasks_do_not_roll_back_each_other(server_cls):
         server.stop()
 
 
-@pytest.mark.parametrize("server_cls", [HttpServer, SocketServer])
+@pytest.mark.parametrize("server_cls", BACKENDS)
 def test_stale_register_cannot_roll_back_live_attempt(server_cls):
     """A zombie executor replaying an OLD attempt's register must not undo the
     live attempt's committed training (guard: only newer attempts roll back)."""
@@ -101,7 +124,7 @@ def test_stale_register_cannot_roll_back_live_attempt(server_cls):
         server.stop()
 
 
-@pytest.mark.parametrize("server_cls", [HttpServer, SocketServer])
+@pytest.mark.parametrize("server_cls", BACKENDS)
 def test_commit_frees_accumulator_and_keeps_weights(server_cls):
     server, client = start(server_cls)
     try:
@@ -111,7 +134,7 @@ def test_commit_frees_accumulator_and_keeps_weights(server_cls):
         # a pull on the same connection orders after the commit opcode
         got = client.get_parameters()
         np.testing.assert_allclose(got[0], W0[0] - 3.0)
-        assert server._attempts == {}  # memory bounded by in-flight tasks
+        assert attempt_count(server) == 0  # bounded by in-flight tasks
         # a later register for the same partition starts a fresh history and
         # cannot roll back the committed work
         client.register_attempt("partition-0", 0)
