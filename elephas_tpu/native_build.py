@@ -41,26 +41,32 @@ def load_native_library(lib_name: str,
         import fcntl
 
         build_dir = os.path.join(NATIVE_DIR, "build")
-        os.makedirs(build_dir, exist_ok=True)
-        with open(os.path.join(build_dir, ".lock"), "w") as lockf:
-            fcntl.flock(lockf, fcntl.LOCK_EX)
-            proc = subprocess.run(
-                ["make", "-C", NATIVE_DIR], capture_output=True, text=True
-            )
-        if proc.returncode != 0:
+        try:
+            os.makedirs(build_dir, exist_ok=True)
+            with open(os.path.join(build_dir, ".lock"), "w") as lockf:
+                fcntl.flock(lockf, fcntl.LOCK_EX)
+                proc = subprocess.run(
+                    ["make", "-C", NATIVE_DIR], capture_output=True, text=True
+                )
+            rc, err = proc.returncode, proc.stderr
+        except OSError as e:
+            # Read-only install (prebuilt .so shipped, tree unwritable):
+            # fall through to loading the existing library.
+            rc, err = -1, f"cannot write {build_dir}: {e}"
+        if rc != 0:
             if not os.path.exists(path) or _stale(path):
                 # No library, or one older than the sources: loading would
                 # run code that no longer matches the tree. Fail loudly.
                 raise RuntimeError(
                     f"native build failed (make -C {NATIVE_DIR}):\n"
-                    f"{proc.stderr[-2000:]}"
+                    f"{err[-2000:]}"
                 )
-            # Up-to-date .so + failed make (e.g. missing toolchain on a
-            # deployment box): usable, but say so.
+            # Up-to-date .so + failed/impossible make (missing toolchain or
+            # read-only install): usable, but say so.
             import warnings
 
             warnings.warn(
-                f"make -C {NATIVE_DIR} failed (rc={proc.returncode}); "
+                f"make -C {NATIVE_DIR} failed (rc={rc}); "
                 f"loading existing up-to-date {lib_name}",
                 RuntimeWarning,
                 stacklevel=2,

@@ -163,11 +163,13 @@ class FitResult:
     """Final weights + Keras-``History``-shaped metrics (+ carryable state)."""
 
     def __init__(self, weights: List[np.ndarray], history: Dict[str, List[float]],
-                 opt_state: Any = None, timings: Optional[Dict[str, float]] = None):
+                 opt_state: Any = None, timings: Optional[Dict[str, float]] = None,
+                 worker_state: Any = None):
         self.weights = weights
         self.history = history
         self.opt_state = opt_state
         self.timings = timings or {}
+        self.worker_state = worker_state
 
 
 class CompiledTrainer:
@@ -209,7 +211,8 @@ class CompiledTrainer:
     def fit(self, blocks: Sequence[Tuple[np.ndarray, np.ndarray]], epochs: int,
             batch_size: int, validation_split: float = 0.0,
             seed: int = 0, verbose: int = 0, opt_state: Any = None,
-            keep_opt_state: bool = False) -> FitResult:
+            keep_opt_state: bool = False, worker_state: Any = None,
+            keep_worker_state: bool = False, epoch_offset: int = 0) -> FitResult:
         """Train over per-worker data ``blocks`` ``[(x_w, y_w), ...]``.
 
         Returns merged weights in ``get_weights()`` order plus per-epoch
@@ -219,6 +222,16 @@ class CompiledTrainer:
         pass ``opt_state`` from a previous ``FitResult`` to continue training
         (checkpoint/resume, epoch-chunked fits) instead of cold-starting the
         optimizer; ``keep_opt_state=True`` returns it on the result.
+
+        Merge-faithful chunking (synchronous+epoch mode only):
+        ``keep_worker_state=True`` makes the compiled program return the
+        per-worker weight stacks UN-merged (``result.worker_state``, with the
+        installed weights being a merged *preview* against the original
+        base); feed that to the next chunk's ``worker_state=`` with
+        ``epoch_offset`` set to the global epoch index so the chunked
+        sequence takes exactly the uninterrupted fit's trajectory — workers
+        train independently across chunk boundaries and the real merge
+        happens once, implicitly, in the last chunk's preview.
         """
         W = len(blocks)
         if W == 0:
@@ -297,22 +310,60 @@ class CompiledTrainer:
         tv0, ntv0 = self.adapter.state_values()
         mergeable = [slot is not None for slot in self.adapter._ntv_slots]
 
+        sync_carry = None
+        if keep_worker_state or worker_state is not None:
+            if not (self.mode == "synchronous" and self.frequency == "epoch"):
+                raise ValueError(
+                    "worker_state carrying applies to synchronous+epoch mode "
+                    f"only (got {self.mode}/{self.frequency}); the other "
+                    "schedules merge within each chunk and are already "
+                    "cadence-faithful under chunking"
+                )
+            sync_carry = "carry" if worker_state is not None else "fresh"
+
         sig = (
             Wp, N, S, B, E, Sv, has_val, self.mode, self.frequency, self.merge,
             tuple(x.shape), tuple(y.shape), str(x.dtype), str(y.dtype),
+            sync_carry,
         )
         if sig not in self._cache:
             self._cache[sig] = self._build(
-                L=L, S=S, B=B, E=E, Sv=Sv, has_val=has_val, mergeable=mergeable
+                L=L, S=S, B=B, E=E, Sv=Sv, has_val=has_val,
+                mergeable=mergeable, sync_carry=sync_carry,
             )
         fit_fn, opt_init_fn = self._cache[sig]
 
         t_start = time.perf_counter()
         if opt_state is None:
             opt_state = opt_init_fn(tv0)
-        tv_out, ntv_out, opt_state_out, metrics = fit_fn(
-            tv0, ntv0, opt_state, x, y, sw, xv, yv, sv, keys, wvalid
-        )
+        ws_out = None
+        if sync_carry is None:
+            tv_out, ntv_out, opt_state_out, metrics = fit_fn(
+                tv0, ntv0, opt_state, x, y, sw, xv, yv, sv, keys, wvalid
+            )
+        else:
+            e0 = jnp.asarray(int(epoch_offset), jnp.int32)
+            if sync_carry == "fresh":
+                (tv_out, ntv_out, opt_state_out, metrics, tv_stack,
+                 ntv_stack) = fit_fn(
+                    tv0, ntv0, opt_state, x, y, sw, xv, yv, sv, keys,
+                    wvalid, e0,
+                )
+                base_tv, base_ntv = tv0, list(ntv0)
+            else:
+                tv_stack_in = worker_state["tv_stack"]
+                ntv_stack_in = worker_state["ntv_stack"]
+                base_tv = worker_state["base_tv"]
+                base_ntv = worker_state["base_ntv"]
+                (tv_out, ntv_out, opt_state_out, metrics, tv_stack,
+                 ntv_stack) = fit_fn(
+                    tv_stack_in, ntv_stack_in, base_tv, base_ntv, opt_state,
+                    x, y, sw, xv, yv, sv, keys, wvalid, e0,
+                )
+            ws_out = {
+                "tv_stack": tv_stack, "ntv_stack": ntv_stack,
+                "base_tv": base_tv, "base_ntv": base_ntv,
+            }
         jax.block_until_ready(tv_out)
         t_run = time.perf_counter() - t_start
 
@@ -342,6 +393,7 @@ class CompiledTrainer:
             opt_state=opt_state_out if keep_opt_state else None,
             timings={"run_seconds": t_run,
                      "samples_per_sec": sum(n_trains) * E / max(t_run, 1e-9)},
+            worker_state=ws_out if keep_worker_state else None,
         )
 
     # ------------------------------------------------------------------
@@ -455,8 +507,17 @@ class CompiledTrainer:
 
     # ------------------------------------------------------------------
     def _build(self, L: int, S: int, B: int, E: int, Sv: int, has_val: bool,
-               mergeable: List[bool]):
-        """Trace+compile the full multi-epoch training program."""
+               mergeable: List[bool], sync_carry: Optional[str] = None):
+        """Trace+compile the full multi-epoch training program.
+
+        ``sync_carry`` (synchronous+epoch mode only) selects the
+        merge-faithful chunked variants used by checkpointed fits:
+        ``"fresh"`` starts worker stacks from the replicated base and
+        ``"carry"`` takes them as inputs; BOTH return the per-worker stacks
+        un-merged (plus a merged *preview* against the original base), so an
+        epoch-chunked sequence reproduces the uninterrupted fit's single
+        end-of-fit merge exactly instead of merging once per chunk.
+        """
         if self.mode == "synchronous" and self.frequency == "batch":
             return self._build_gradsync(
                 L=L, S=S, B=B, E=E, Sv=Sv, has_val=has_val, mergeable=mergeable
@@ -621,6 +682,92 @@ class CompiledTrainer:
         mesh = self.mesh
         pspec_rep = P()
         pspec_data = P(DATA_AXIS)
+
+        if sync_carry is not None:
+            if merge_every_epoch or merge_every_batch:
+                raise ValueError(
+                    "sync_carry variants exist only for synchronous+epoch "
+                    f"mode, not {self.mode}/{self.frequency}"
+                )
+
+            def carry_core(tv_stack, ntv_stack, base_tv, base_ntv, opt_stack,
+                           x, y, sw, xv, yv, sv, keys, wvalid, e0):
+                denom = jnp.maximum(
+                    jax.lax.psum(jnp.sum(wvalid), DATA_AXIS), 1.0
+                )
+
+                def epoch_body(carry, e):
+                    tv_stack, ntv_stack, opt_stack = carry
+                    # fold the GLOBAL epoch index so a chunked sequence
+                    # shuffles identically to the uninterrupted fit
+                    ekeys = jax.vmap(
+                        lambda k: jax.random.fold_in(k, e + e0)
+                    )(keys)
+                    tv_stack, ntv_stack, opt_stack, stats = jax.vmap(
+                        local_epoch
+                    )(tv_stack, ntv_stack, opt_stack, x, y, sw, ekeys)
+                    metrics = _psum_weighted_means(stats)
+                    if has_val:
+                        vstats = jax.vmap(
+                            lambda tv, ntv, a, b, c: local_eval(tv, ntv, a, b, c)
+                        )(tv_stack, ntv_stack, xv, yv, sv)
+                        metrics.update(_psum_val_metrics(vstats))
+                    return (tv_stack, ntv_stack, opt_stack), metrics
+
+                (tv_stack, ntv_stack, opt_stack), metrics = jax.lax.scan(
+                    epoch_body, (tv_stack, ntv_stack, opt_stack),
+                    jnp.arange(E),
+                )
+                # merged PREVIEW against the ORIGINAL base: on the final
+                # chunk this IS the uninterrupted fit's single merge
+                merged_tv = merge_tv(tv_stack, base_tv, wvalid, denom)
+                merged_full = merge_ntv(ntv_stack, base_ntv, wvalid, denom)
+                merged_base_ntv = [v[0] for v in merged_full]
+                ntv_mergeable_out = [
+                    v for v, m in zip(merged_base_ntv, mergeable) if m
+                ]
+                return (merged_tv, ntv_mergeable_out, opt_stack, metrics,
+                        tv_stack, ntv_stack)
+
+            if sync_carry == "fresh":
+                def fit_carry(tv0, ntv0, opt_stack, x, y, sw, xv, yv, sv,
+                              keys, wvalid, e0):
+                    tv_stack = jax.tree_util.tree_map(tile, tv0)
+                    ntv_stack = _seeded_ntv_stack(ntv0, mergeable, L)
+                    return carry_core(
+                        tv_stack, ntv_stack, tv0, list(ntv0), opt_stack,
+                        x, y, sw, xv, yv, sv, keys, wvalid, e0,
+                    )
+
+                in_specs = (
+                    pspec_rep, pspec_rep, pspec_data, pspec_data, pspec_data,
+                    pspec_data, pspec_data, pspec_data, pspec_data,
+                    pspec_data, pspec_data, pspec_rep,
+                )
+                donate = (2,)
+            else:  # "carry"
+                fit_carry = carry_core
+                in_specs = (
+                    pspec_data, pspec_data, pspec_rep, pspec_rep, pspec_data,
+                    pspec_data, pspec_data, pspec_data, pspec_data,
+                    pspec_data, pspec_data, pspec_data, pspec_data, pspec_rep,
+                )
+                # stacks and opt_stack are consumed and re-returned
+                donate = (0, 1, 4)
+
+            shard_fit = jax.shard_map(
+                fit_carry, mesh=mesh, in_specs=in_specs,
+                out_specs=(pspec_rep, pspec_rep, pspec_data, pspec_rep,
+                           pspec_data, pspec_data),
+                check_vma=False,
+            )
+            shard_opt_init = jax.shard_map(
+                opt_init_impl, mesh=mesh, in_specs=(pspec_rep,),
+                out_specs=pspec_data, check_vma=False,
+            )
+            return (jax.jit(shard_fit, donate_argnums=donate),
+                    jax.jit(shard_opt_init))
+
         shard_fit = jax.shard_map(
             fit_impl,
             mesh=mesh,

@@ -239,32 +239,20 @@ class NativeClient(BaseParameterClient):
                 # the double-apply hole. Let task retry handle it.
                 raise
             except ConnectionError:
-                # Ambiguous: a pre-extension server drops unknown opcodes
-                # (indistinguishable from a reset), but so does a transient
-                # fault — and the server may ALREADY have registered the
-                # attempt. Disambiguate with a fresh-connection liveness
-                # probe: a server that answers a plain GET but dropped 'R'
-                # is pre-extension (degrade to untagged); an unreachable
-                # one is a transient fault (re-raise — degrading would
-                # reopen the double-apply hole; task retry handles it).
+                # A pre-extension server dropping the unknown 'R' opcode is
+                # indistinguishable on this binary protocol from a transient
+                # reset on a CURRENT server — which may already have created
+                # the attempt record with the ack lost. Degrading to
+                # untagged pushes in that second case silently reopens the
+                # double-apply hole the extension closes, so the safe
+                # direction is to fail the attempt (task retry handles it).
+                # Every shipped native server implements the extension;
+                # pre-extension servers are not supported for degradation.
                 try:
                     sock.close()
                 finally:
                     self._sock = None
-                probe = socket.create_connection(
-                    (self.host, self.port), timeout=30
-                )
-                try:
-                    probe.sendall(b"G")
-                    n = struct.unpack("<I", self._read_exact(probe, 4))[0]
-                    for _ in range(n):
-                        (nelem,) = struct.unpack(
-                            "<Q", self._read_exact(probe, 8)
-                        )
-                        self._read_exact(probe, int(nelem) * 4)
-                finally:
-                    probe.close()
-                return False
+                raise
             if ack != b"k":
                 try:
                     sock.close()

@@ -277,28 +277,78 @@ class SparkModel:
             return
 
         # Checkpointed path: epoch-chunked fits carrying optimizer state.
-        # NOTE: in synchronous mode this merges per chunk instead of once per
-        # fit (the compiled program spans one chunk).
-        from .utils.checkpoint import has_checkpoint, load_checkpoint, save_checkpoint
+        # Synchronous+epoch mode additionally carries the per-worker weight
+        # stacks across chunks (engine worker_state), so the chunked sequence
+        # merges ONCE — exactly like the uninterrupted fit — instead of once
+        # per chunk; each checkpoint's weights are the merged preview of the
+        # stacks at that boundary (what you'd get by merging right then).
+        from .utils.checkpoint import (
+            has_checkpoint, load_checkpoint, load_pytree, save_checkpoint,
+            save_pytree,
+        )
 
-        start_epoch, opt_state = 0, None
+        sync_faithful = (
+            self.mode == "synchronous" and self.frequency == "epoch"
+        )
+        ws_path = os.path.join(checkpoint_dir, "worker_state")
+        start_epoch, opt_state, worker_state = 0, None, None
         if resume and has_checkpoint(checkpoint_dir):
             weights, meta, opt_state = load_checkpoint(checkpoint_dir)
             self._master_network.set_weights(weights)
             start_epoch = int(meta.get("epoch", 0))
+            if sync_faithful and start_epoch > 0:
+                # worker_state is written in a separate step from meta.json,
+                # so validate its epoch stamp: a crash between the two
+                # writes (or an older checkpoint without stacks) must not
+                # silently continue from mismatched per-worker state.
+                ws_epoch = -1
+                if os.path.isdir(ws_path):
+                    worker_state = load_pytree(ws_path)
+                    ws_epoch = int(worker_state.pop("epoch", -1))
+                if ws_epoch != start_epoch:
+                    import warnings
+
+                    warnings.warn(
+                        f"checkpoint {checkpoint_dir}: worker_state is "
+                        f"{'missing' if worker_state is None else f'stamped epoch {ws_epoch}'}"
+                        f" but meta says epoch {start_epoch}; resuming from "
+                        "the merged checkpoint weights with fresh worker "
+                        "stacks (merge-faithfulness to the uninterrupted "
+                        "fit is lost for this run)",
+                        RuntimeWarning,
+                    )
+                    worker_state = None
         merged: Dict[str, List[float]] = {}
         epoch = start_epoch
         while epoch < epochs:
             chunk = min(checkpoint_frequency, epochs - epoch)
-            result = trainer.fit(
-                blocks, epochs=chunk, batch_size=batch_size,
-                validation_split=validation_split, verbose=verbose,
-                seed=epoch, opt_state=opt_state, keep_opt_state=True,
-            )
+            if sync_faithful:
+                # seed stays 0 and the GLOBAL epoch index is folded inside
+                # the program, matching the uninterrupted fit's shuffles
+                result = trainer.fit(
+                    blocks, epochs=chunk, batch_size=batch_size,
+                    validation_split=validation_split, verbose=verbose,
+                    seed=0, epoch_offset=epoch, opt_state=opt_state,
+                    keep_opt_state=True, worker_state=worker_state,
+                    keep_worker_state=True,
+                )
+                worker_state = result.worker_state
+            else:
+                result = trainer.fit(
+                    blocks, epochs=chunk, batch_size=batch_size,
+                    validation_split=validation_split, verbose=verbose,
+                    seed=epoch, opt_state=opt_state, keep_opt_state=True,
+                )
             opt_state = result.opt_state
             for k, v in result.history.items():
                 merged.setdefault(k, []).extend(v)
             epoch += chunk
+            if sync_faithful:
+                # stacks first, meta last: meta.json is the commit point,
+                # and resume validates the stamp below against meta's epoch
+                save_pytree(
+                    ws_path, {**worker_state, "epoch": np.int64(epoch)}
+                )
             save_checkpoint(
                 checkpoint_dir, result.weights,
                 {"epoch": epoch, "epochs": epochs, "mode": self.mode},

@@ -38,6 +38,101 @@ def test_checkpoint_and_resume(tmp_path, spark_context, toy_classification):
     assert sm2.training_histories[-1]["loss"][-1] < sm.training_histories[-1]["loss"][0]
 
 
+def test_checkpointed_sync_fit_is_merge_faithful(
+    tmp_path, spark_context, toy_classification
+):
+    """Turning on checkpoint_dir must NOT change synchronous-mode semantics:
+    the chunked fit carries per-worker weight stacks across chunks and
+    merges once, so its final weights equal the uninterrupted fit's."""
+    x, y = toy_classification
+    rdd = to_simple_rdd(spark_context, x, y)
+
+    m_plain = make_classifier()
+    init = [np.array(w) for w in m_plain.get_weights()]
+    plain = SparkModel(m_plain, mode="synchronous", num_workers=4)
+    plain.fit(rdd, epochs=4, batch_size=16, validation_split=0.0)
+
+    m_chunk = make_classifier()
+    m_chunk.set_weights(init)
+    chunked = SparkModel(m_chunk, mode="synchronous", num_workers=4)
+    chunked.fit(rdd, epochs=4, batch_size=16, validation_split=0.0,
+                checkpoint_dir=str(tmp_path / "ckpt_eq"),
+                checkpoint_frequency=1)
+
+    for a, b in zip(plain.master_network.get_weights(),
+                    chunked.master_network.get_weights()):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    # per-epoch (pre-merge) histories line up too
+    np.testing.assert_allclose(
+        plain.training_histories[-1]["loss"],
+        chunked.training_histories[-1]["loss"], rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_sync_resume_reproduces_uninterrupted_fit(
+    tmp_path, spark_context, toy_classification
+):
+    """Kill-and-resume across processes: a sync fit resumed from disk (worker
+    stacks reloaded) ends at the same weights as one that never stopped."""
+    x, y = toy_classification
+    rdd = to_simple_rdd(spark_context, x, y)
+    ckpt = str(tmp_path / "ckpt_resume")
+
+    m_plain = make_classifier()
+    init = [np.array(w) for w in m_plain.get_weights()]
+    plain = SparkModel(m_plain, mode="synchronous", num_workers=4)
+    plain.fit(rdd, epochs=4, batch_size=16, validation_split=0.0)
+
+    m_first = make_classifier()
+    m_first.set_weights(init)
+    first = SparkModel(m_first, mode="synchronous", num_workers=4)
+    first.fit(rdd, epochs=2, batch_size=16, validation_split=0.0,
+              checkpoint_dir=ckpt, checkpoint_frequency=2)
+    # "crash": a NEW SparkModel resumes epochs 2..4 from the checkpoint
+    second = SparkModel(make_classifier(), mode="synchronous", num_workers=4)
+    second.fit(rdd, epochs=4, batch_size=16, validation_split=0.0,
+               checkpoint_dir=ckpt, checkpoint_frequency=2, resume=True)
+
+    for a, b in zip(plain.master_network.get_weights(),
+                    second.master_network.get_weights()):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_resume_with_stale_worker_state_warns_and_restarts_stacks(
+    tmp_path, spark_context, toy_classification
+):
+    """A crash between the worker_state and meta writes leaves mismatched
+    epoch stamps; resume must warn and fall back to fresh stacks, not
+    silently continue from the wrong per-worker state."""
+    import warnings
+
+    from elephas_tpu.utils.checkpoint import load_pytree, save_pytree
+
+    x, y = toy_classification
+    rdd = to_simple_rdd(spark_context, x, y)
+    ckpt = str(tmp_path / "ckpt_stale")
+
+    sm = SparkModel(make_classifier(), mode="synchronous", num_workers=4)
+    sm.fit(rdd, epochs=2, batch_size=16, validation_split=0.0,
+           checkpoint_dir=ckpt, checkpoint_frequency=2)
+    # corrupt the stamp to simulate the torn write
+    ws_path = str(tmp_path / "ckpt_stale" / "worker_state")
+    ws = load_pytree(ws_path)
+    ws["epoch"] = np.int64(999)
+    save_pytree(ws_path, ws)
+
+    sm2 = SparkModel(make_classifier(), mode="synchronous", num_workers=4)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        sm2.fit(rdd, epochs=4, batch_size=16, validation_split=0.0,
+                checkpoint_dir=ckpt, checkpoint_frequency=2, resume=True)
+    assert any("worker_state" in str(w.message) for w in caught)
+    # and training still completed the remaining epochs
+    assert len(sm2.training_histories[-1]["loss"]) == 2
+
+
 def test_timings_recorded(spark_context, toy_classification):
     x, y = toy_classification
     rdd = to_simple_rdd(spark_context, x, y)
