@@ -26,8 +26,46 @@ if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", "")
     )
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+import re
+import sys
+
 import numpy as np
 import pytest
+
+# The 8-device CPU mesh has one known flake: XLA's CPU collective rendezvous
+# can starve in long tight loops (CollectivePermute timeout / rendezvous
+# deadlock — see docs/DISTRIBUTED.md). Tests keep step counts small to avoid
+# it, but the harness must not rely on that convention alone: a failure whose
+# output matches the signature is retried ONCE. Anything else fails normally
+# — this must never mask a real bug, so the pattern is deliberately narrow.
+_COLLECTIVE_FLAKE = re.compile(
+    r"CollectivePermute"
+    r"|[Rr]endezvous.{0,120}(tim(e|ed)[ -]?out|abort|deadlock|starv)"
+    r"|(tim(e|ed)[ -]?out|deadlock|starv\w*).{0,120}[Rr]endezvous",
+    re.DOTALL,
+)
+
+
+def pytest_runtest_protocol(item, nextitem):
+    from _pytest.runner import runtestprotocol
+
+    hook = item.ihook
+    hook.pytest_runtest_logstart(nodeid=item.nodeid, location=item.location)
+    reports = runtestprotocol(item, nextitem=nextitem, log=False)
+    if any(
+        r.when == "call" and r.failed
+        and _COLLECTIVE_FLAKE.search(str(r.longrepr))
+        for r in reports
+    ):
+        sys.stderr.write(
+            f"\n[conftest] known CPU-collective rendezvous flake in "
+            f"{item.nodeid}; retrying once\n"
+        )
+        reports = runtestprotocol(item, nextitem=nextitem, log=False)
+    for report in reports:
+        hook.pytest_runtest_logreport(report=report)
+    hook.pytest_runtest_logfinish(nodeid=item.nodeid, location=item.location)
+    return True
 
 
 @pytest.fixture(scope="session")

@@ -25,6 +25,7 @@ EXAMPLES = [
     "transformer_lm.py",
     "parallelism_tour.py",
     "lm_inference_tour.py",
+    "resnet50_spark.py",
 ]
 
 
@@ -42,9 +43,17 @@ def test_example_runs(script):
         "EX_SAMPLES": "2048",
         "EX_EPOCHS": "1",
         "EX_STEPS": "12",
+        # resnet50: 8 workers x 20 samples > batch_size(16); one epoch of
+        # the conv stack compiles+runs in ~100s on the CPU mesh
+        "RESNET_SAMPLES": "160",
+        "RESNET_EPOCHS": "1",
     })
     proc = subprocess.run(
         [sys.executable, os.path.join(_REPO, "examples", script)],
         env=env, capture_output=True, text=True, timeout=600,
     )
     assert proc.returncode == 0, (proc.stdout + proc.stderr)[-3000:]
+    if script == "resnet50_spark.py":
+        # the remat lever must stay on — ResNet-class activation footprints
+        # are the reason SparkModel(remat=...) exists
+        assert "remat=True" in proc.stdout, proc.stdout[-2000:]

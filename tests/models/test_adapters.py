@@ -124,3 +124,25 @@ def test_loss_matches_keras_reference():
     ours = np.asarray(resolve_per_sample_loss("categorical_crossentropy")(y, p))
     theirs = np.asarray(keras.losses.categorical_crossentropy(y, p))
     assert np.allclose(ours, theirs, atol=1e-5)
+
+
+def test_remat_flag_reaches_the_compiled_program(
+    classifier_factory, toy_classification
+):
+    """SparkModel(remat=True) must actually change the compiled program —
+    guard against the flag being silently dropped somewhere between the
+    constructor and build_train_step (the resnet50 example relies on it)."""
+    import jax
+
+    x, y = toy_classification
+    adapter = KerasModelAdapter(classifier_factory())
+    opt = adapter.make_optimizer()
+    tv, ntv = adapter.state_values()
+    opt_state = opt.init(tv)
+    sw = np.ones((64,), "float32")
+    args = (tv, ntv, opt_state, x[:64], y[:64], sw)
+
+    plain = str(jax.make_jaxpr(adapter.build_train_step(opt))(*args))
+    remat = str(jax.make_jaxpr(adapter.build_train_step(opt, remat=True))(*args))
+    assert "remat" not in plain
+    assert "remat" in remat  # jax.checkpoint lowers to the remat primitive
