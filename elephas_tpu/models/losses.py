@@ -119,6 +119,24 @@ def _loss_name_and_config(loss_spec) -> Tuple[str, dict]:
     return _ALIASES.get(name, name), cfg
 
 
+def _align_rank(fn: Callable) -> Callable:
+    """Match Keras's implicit rank alignment: scalar-per-sample targets
+    (``y_true [B]``) against a trailing-unit output (``y_pred [B, 1]``) get a
+    trailing axis. Without this, elementwise losses would silently broadcast
+    ``[B,1] - [B]`` to ``[B,B]`` — the loss still decreases (toward the
+    target variance) while the gradients are garbage, which is exactly how
+    the bug hid in regression fits through ``SparkMLlibModel``.
+    """
+    def aligned(y_true, y_pred):
+        if y_true.ndim == y_pred.ndim - 1 and y_pred.shape[-1] == 1:
+            y_true = y_true[..., None]
+        elif y_true.ndim == y_pred.ndim + 1 and y_true.shape[-1] == 1:
+            y_true = y_true[..., 0]
+        return fn(y_true, y_pred)
+
+    return aligned
+
+
 def resolve_per_sample_loss(loss_spec) -> Callable:
     """Return ``fn(y_true, y_pred) -> [batch]`` per-sample losses.
 
@@ -128,19 +146,19 @@ def resolve_per_sample_loss(loss_spec) -> Callable:
     from_logits = bool(cfg.get("from_logits", False))
 
     if name in ("mean_squared_error",):
-        return _mse
+        return _align_rank(_mse)
     if name in ("mean_absolute_error",):
-        return _mae
+        return _align_rank(_mae)
     if name == "binary_crossentropy":
-        return _binary_crossentropy(from_logits)
+        return _align_rank(_binary_crossentropy(from_logits))
     if name == "categorical_crossentropy":
         return _categorical_crossentropy(from_logits)
     if name == "sparse_categorical_crossentropy":
         return _sparse_categorical_crossentropy(from_logits)
     if name == "hinge":
-        return _hinge
+        return _align_rank(_hinge)
     if name in ("huber", "huber_loss"):
-        return _huber(float(cfg.get("delta", 1.0)))
+        return _align_rank(_huber(float(cfg.get("delta", 1.0))))
 
     # Fallback: resolve through Keras. Keras Loss objects reduce to a scalar;
     # broadcast that scalar to per-sample shape so masking still works

@@ -5,11 +5,15 @@ Rebuild of reference ``elephas/utils/serialization.py:~1``:
 model.to_yaml(), 'weights': model.get_weights()}``; Keras 3 removed YAML, so
 the architecture travels as the JSON config (the newer-TF variant the
 maintained fork already uses — SURVEY.md §2.5) and weights as a list of numpy
-arrays. Also provides npz-based weight persistence used by checkpointing.
+arrays. OLD artifacts still load: :func:`dict_to_model` detects a YAML
+``'model'`` entry (the reference's ``to_yaml`` output) and converts it to
+the JSON config on the fly. Also provides npz-based weight persistence used
+by checkpointing.
 """
 
 from __future__ import annotations
 
+import json
 from typing import Any, Dict, List, Optional
 
 import numpy as np
@@ -23,11 +27,32 @@ def model_to_dict(model) -> Dict[str, Any]:
     }
 
 
+def yaml_config_to_json(config: str) -> str:
+    """Old-style ``model.to_yaml()`` architecture string → JSON config.
+
+    Keras 3 removed ``to_yaml``/``model_from_yaml``; artifacts the reference
+    saved with them carry the SAME config structure serialized as YAML, so a
+    parse-and-redump is enough to load them through ``model_from_json``.
+    """
+    try:
+        import yaml
+    except ImportError as e:  # pragma: no cover - PyYAML is normally present
+        raise ValueError(
+            "this artifact stores a YAML model config (reference to_yaml "
+            "format) and PyYAML is not installed to convert it"
+        ) from e
+    return json.dumps(yaml.safe_load(config))
+
+
 def dict_to_model(d: Dict[str, Any], custom_objects: Optional[dict] = None):
-    """Inverse of :func:`model_to_dict`."""
+    """Inverse of :func:`model_to_dict`; also accepts the reference's
+    old-style dicts whose ``'model'`` entry is a YAML config."""
     import keras
 
-    model = keras.models.model_from_json(d["model"], custom_objects=custom_objects)
+    config = d["model"]
+    if not config.lstrip().startswith("{"):  # JSON configs are objects;
+        config = yaml_config_to_json(config)  # YAML ones start with a key
+    model = keras.models.model_from_json(config, custom_objects=custom_objects)
     model.set_weights(d["weights"])
     return model
 

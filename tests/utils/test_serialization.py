@@ -24,3 +24,23 @@ def test_weights_npz_round_trip(tmp_path, classifier_factory):
     loaded = load_weights_npz(path)
     for w1, w2 in zip(model.get_weights(), loaded):
         assert np.allclose(w1, w2)
+
+
+def test_old_style_yaml_config_loads(classifier_factory):
+    """Reference-era artifacts stored model.to_yaml(); dict_to_model must
+    accept them (YAML → JSON config conversion on the fly)."""
+    import json
+
+    import yaml
+
+    from elephas_tpu.utils.serialization import dict_to_model, model_to_dict
+
+    model = classifier_factory()
+    d = model_to_dict(model)
+    legacy = {
+        "model": yaml.safe_dump(json.loads(d["model"])),  # to_yaml analog
+        "weights": d["weights"],
+    }
+    loaded = dict_to_model(legacy)
+    for a, b in zip(model.get_weights(), loaded.get_weights()):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
