@@ -113,7 +113,11 @@ def bench_lm(reps: int):
 
     d_model = int(os.environ.get("BENCH_LM_DMODEL", 1024))
     n_layers = int(os.environ.get("BENCH_LM_LAYERS", 8))
-    n_heads = int(os.environ.get("BENCH_LM_HEADS", 16))
+    # Dh=128 heads: the MXU contracts 128-deep, so Dh=64 heads run the
+    # attention dots at half occupancy (measured: H16/Dh64 28.6% MFU vs
+    # H8/Dh128 38.1% on the same d_model) — 128 is also the standard
+    # modern head size (Llama/PaLM class).
+    n_heads = int(os.environ.get("BENCH_LM_HEADS", 8))
     d_ff = int(os.environ.get("BENCH_LM_DFF", 4 * d_model))
     vocab = int(os.environ.get("BENCH_LM_VOCAB", 8192))
     seq = int(os.environ.get("BENCH_LM_SEQ", 2048))
