@@ -277,7 +277,8 @@ def _dkv_kernel(causal: bool, bq: int, bk: int, t_true: int, scale: float,
         dv_ref[0, 0] = dv_s[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd_tpu(q, k, v, o, lse, do, causal, bq, bk, interpret):
+def _flash_bwd_tpu(q, k, v, o, lse, do, causal, bq, bk, interpret,
+                   delta_minus=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -292,6 +293,10 @@ def _flash_bwd_tpu(q, k, v, o, lse, do, causal, bq, bk, interpret):
                 axis=-1)[:, :, None, :],
         lse.shape,
     )
+    if delta_minus is not None:
+        # lse cotangent (see flash_attention_with_lse): ds gains
+        # p·g_lse, which is exactly Δ → Δ − g_lse in the shared kernels.
+        delta = delta - delta_minus
     if Tq != T:
         pad_q = ((0, 0), (0, 0), (0, Tq - T), (0, 0))
         q, do = jnp.pad(q, pad_q), jnp.pad(do, pad_q)
@@ -399,3 +404,52 @@ def _fa_bwd(causal, block_q, block_k, interpret, res, g):
 
 
 flash_attention_tpu.defvjp(_fa_fwd, _fa_bwd)
+
+
+# -- (out, lse) variant: the building block for cross-shard merges ------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_with_lse(q, k, v, causal: bool = False,
+                             block_q: int = _BQ, block_k: int = _BK,
+                             interpret: bool = False):
+    """Like :func:`flash_attention_tpu` but also returns the per-row
+    ``lse = logsumexp(scores)`` as ``[B, T, H]`` float32 — DIFFERENTIABLY.
+
+    This is the primitive a cross-shard softmax merge needs (ring
+    attention combines per-visit partial attentions by their lse). The
+    lse cotangent costs nothing extra in the backward: ``∂lse_i/∂s_ij =
+    p_ij``, so it folds into the FlashAttention-2 ``Δ`` term —
+    ``ds = p∘(dp − Δ)`` becomes ``p∘(dp − (Δ − g_lse))`` — and the same
+    kernels run unchanged with ``Δ_eff = Δ − g_lse``.
+    """
+    (out, lse), _ = _fal_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out, lse
+
+
+def _fal_fwd(q, k, v, causal, block_q, block_k, interpret):
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    o, lse8 = _flash_fwd_tpu(qt, kt, vt, causal, block_q, block_k, interpret)
+    lse_out = jnp.transpose(lse8[:, :, 0, :], (0, 2, 1))  # [B, T, H]
+    return ((jnp.swapaxes(o, 1, 2), lse_out),
+            (qt, kt, vt, o, lse8))
+
+
+def _fal_bwd(causal, block_q, block_k, interpret, res, cts):
+    qt, kt, vt, o, lse8 = res
+    g, g_lse = cts
+    do = jnp.swapaxes(g, 1, 2)
+    # [B, T, H] → the kernels' [B, H, 8, T] sublane-broadcast layout
+    g_lse8 = jnp.broadcast_to(
+        jnp.transpose(g_lse, (0, 2, 1))[:, :, None, :], lse8.shape
+    ).astype(jnp.float32)
+    dq, dk, dv = _flash_bwd_tpu(qt, kt, vt, o, lse8, do, causal,
+                                block_q, block_k, interpret,
+                                delta_minus=g_lse8)
+    return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
+            jnp.swapaxes(dv, 1, 2))
+
+
+flash_attention_with_lse.defvjp(_fal_fwd, _fal_bwd)

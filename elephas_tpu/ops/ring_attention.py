@@ -116,11 +116,96 @@ def _ring_attention_local(q, k, v, causal: bool, axis_name: str):
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B, Tq, H, D]
 
 
-# Public alias: the per-shard body for composing ring attention INSIDE a
-# larger shard_map program (e.g. the sequence-parallel transformer in
-# ``models/transformer.py``) rather than through the standalone
-# ``ring_attention`` wrapper below.
-ring_attention_local = _ring_attention_local
+def _ring_flash_local(q, k, v, causal: bool, axis_name: str,
+                      interpret: bool = False):
+    """TPU per-shard ring body: per-visit Pallas flash + lse merge.
+
+    Each visiting KV block is attended with the fused
+    :func:`~elephas_tpu.ops.pallas_flash.flash_attention_with_lse` kernel
+    (score tiles stay in VMEM — the jnp fold above materializes a
+    ``[B, H, Tq, Tk]`` score tensor in HBM per visit), and the per-visit
+    normalized partials merge by their logsumexp:
+
+        out_{S∪j} = (out_S·e^{lse_S} + o_j·e^{lse_j}) / e^{logaddexp}
+
+    computed max-shifted. Causality is decided per VISIT from the block's
+    origin rank — fully visible (origin < rank, plain flash), the diagonal
+    (origin == rank, causal flash), or skipped (origin > rank) via
+    ``lax.switch``; within-block positions then need no global offsets.
+    Gradients flow through the kernel's custom VJP (the lse cotangent folds
+    into its Δ term) and the jnp merge — no hand-written ring backward.
+    Autodiff stores per-visit residuals (O(P · local block) — the memory
+    the forward saves is the score tensor, not the residual stream).
+    """
+    p = jax.lax.axis_size(axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    from .pallas_flash import flash_attention_with_lse
+
+    b, tq, h, _ = q.shape
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    from .pallas_flash import _BK, _BQ
+
+    def full(q, kb, vb):
+        return flash_attention_with_lse(q, kb, vb, False, _BQ, _BK,
+                                        interpret)
+
+    def diag(q, kb, vb):
+        return flash_attention_with_lse(q, kb, vb, True, _BQ, _BK,
+                                        interpret)
+
+    def skip(q, kb, vb):
+        return (jnp.zeros(q.shape, q.dtype),
+                jnp.full((b, tq, h), -jnp.inf, jnp.float32))
+
+    def visit(acc, lse_acc, kb, vb, j):
+        src = (rank - j) % p
+        if causal:
+            # 0: origin > rank (invisible), 1: diagonal, 2: fully visible
+            idx = (src < rank).astype(jnp.int32) * 2 + (
+                src == rank
+            ).astype(jnp.int32)
+            o_j, lse_j = jax.lax.switch(idx, [skip, diag, full], q, kb, vb)
+        else:
+            o_j, lse_j = full(q, kb, vb)
+        m = jnp.maximum(lse_acc, lse_j)
+        w_acc = jnp.exp(lse_acc - m)   # first visit: exp(-inf − finite) = 0
+        w_j = jnp.exp(lse_j - m)
+        denom = w_acc + w_j            # ≥ 1 (the max contributes exactly 1)
+        acc = (acc * w_acc[..., None]
+               + o_j.astype(jnp.float32) * w_j[..., None]) / denom[..., None]
+        return acc, m + jnp.log(denom)
+
+    def fold(carry, j):
+        acc, lse_acc, kb, vb = carry
+        acc, lse_acc = visit(acc, lse_acc, kb, vb, j)
+        kb = jax.lax.ppermute(kb, axis_name, perm)
+        vb = jax.lax.ppermute(vb, axis_name, perm)
+        return (acc, lse_acc, kb, vb), None
+
+    acc0 = jnp.zeros(q.shape, jnp.float32)
+    lse0 = jnp.full((b, tq, h), -jnp.inf, jnp.float32)
+    # p-1 rotated steps, then the last visiting block folded WITHOUT the
+    # trailing (discarded) rotation — saves one ppermute pair per call,
+    # mirroring the jnp fold above.
+    (acc, lse_acc, kb, vb), _ = jax.lax.scan(
+        fold, (acc0, lse0, k, v), jnp.arange(p - 1)
+    )
+    acc, _ = visit(acc, lse_acc, kb, vb, p - 1)
+    return acc.astype(q.dtype)
+
+
+def ring_attention_local(q, k, v, causal: bool, axis_name: str):
+    """Per-shard ring attention body for composing INSIDE a larger
+    shard_map program (e.g. the sequence-parallel transformer in
+    ``models/transformer.py``): the fused Pallas path on TPU, the jnp
+    online-softmax fold elsewhere (also the oracle the TPU path is tested
+    against, in interpret mode)."""
+    from .pallas_ops import is_tpu_backend
+
+    if is_tpu_backend():
+        return _ring_flash_local(q, k, v, causal, axis_name)
+    return _ring_attention_local(q, k, v, causal, axis_name)
 
 _COMPILED = {}
 
@@ -175,5 +260,5 @@ def ring_attention(q, k, v, mesh=None, causal: bool = False,
     if t % p:
         raise ValueError(f"sequence length {t} not divisible by ring size {p}")
     return sharded_seq_attention(
-        "ring", _ring_attention_local, mesh, axis_name, causal, q, k, v
+        "ring", ring_attention_local, mesh, axis_name, causal, q, k, v
     )
