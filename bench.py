@@ -120,6 +120,7 @@ def bench_lm(reps: int):
     n_heads = int(os.environ.get("BENCH_LM_HEADS", 8))
     d_ff = int(os.environ.get("BENCH_LM_DFF", 4 * d_model))
     vocab = int(os.environ.get("BENCH_LM_VOCAB", 8192))
+    n_kv = os.environ.get("BENCH_LM_KV_HEADS")  # GQA: fewer KV heads
     seq = int(os.environ.get("BENCH_LM_SEQ", 2048))
     batch = int(os.environ.get("BENCH_LM_BATCH", 8))
     steps = int(os.environ.get("BENCH_LM_STEPS", 10))
@@ -129,6 +130,7 @@ def bench_lm(reps: int):
         vocab=vocab, d_model=d_model, n_heads=n_heads, n_layers=n_layers,
         d_ff=d_ff, max_len=seq, compute_dtype="bfloat16",
         pos_encoding="rotary", tie_embeddings=True,
+        n_kv_heads=int(n_kv) if n_kv else None,
     )
     mesh = build_mesh_sp(data=1, seq=1)
     step, opt_init = build_lm_train_step(
@@ -176,7 +178,8 @@ def bench_lm(reps: int):
         "mfu": round(mfu, 4) if mfu is not None else None,
         "step_ms": round(best_dt / steps * 1e3, 2),
         "flops_per_token": round(flops_tok),
-        "config": f"d{d_model}xL{n_layers}xH{n_heads}xT{seq}xB{batch}"
+        "config": f"d{d_model}xL{n_layers}xH{n_heads}"
+                  f"{f'kv{n_kv}' if n_kv else ''}xT{seq}xB{batch}"
                   f"-V{vocab}-bf16-flash",
     }
 

@@ -386,21 +386,19 @@ def flash_attention_tpu(q, k, v, causal: bool = False, block_q: int = _BQ,
     return out
 
 
+# Thin delegates over the (out, lse) variant below — ONE set of
+# swapaxes/residual/backward wrappers to keep in sync, not two.
 def _fa_fwd(q, k, v, causal, block_q, block_k, interpret):
-    qt = jnp.swapaxes(q, 1, 2)   # [B, H, T, Dh]
-    kt = jnp.swapaxes(k, 1, 2)
-    vt = jnp.swapaxes(v, 1, 2)
-    o, lse = _flash_fwd_tpu(qt, kt, vt, causal, block_q, block_k, interpret)
-    return jnp.swapaxes(o, 1, 2), (qt, kt, vt, o, lse)
+    (out, _lse), res = _fal_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out, res
 
 
 def _fa_bwd(causal, block_q, block_k, interpret, res, g):
-    qt, kt, vt, o, lse = res
-    do = jnp.swapaxes(g, 1, 2)
-    dq, dk, dv = _flash_bwd_tpu(qt, kt, vt, o, lse, do, causal,
-                                block_q, block_k, interpret)
-    return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
-            jnp.swapaxes(dv, 1, 2))
+    lse8 = res[4]
+    zero_lse = jnp.zeros(
+        (lse8.shape[0], lse8.shape[3], lse8.shape[1]), jnp.float32
+    )  # Δ − 0 = Δ: the plain variant has no lse cotangent
+    return _fal_bwd(causal, block_q, block_k, interpret, res, (g, zero_lse))
 
 
 flash_attention_tpu.defvjp(_fa_fwd, _fa_bwd)

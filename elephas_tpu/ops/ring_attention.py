@@ -199,8 +199,9 @@ def ring_attention_local(q, k, v, causal: bool, axis_name: str):
     """Per-shard ring attention body for composing INSIDE a larger
     shard_map program (e.g. the sequence-parallel transformer in
     ``models/transformer.py``): the fused Pallas path on TPU, the jnp
-    online-softmax fold elsewhere (also the oracle the TPU path is tested
-    against, in interpret mode)."""
+    online-softmax fold elsewhere. Both branches are pinned against the
+    dense ``attention_reference`` oracle (the Pallas one in interpret mode,
+    ``tests/ops/test_pallas_flash.py``)."""
     from .pallas_ops import is_tpu_backend
 
     if is_tpu_backend():
