@@ -129,6 +129,23 @@ def maybe_decode(obj: Any) -> List[np.ndarray]:
     return obj
 
 
+def flush_residual(codec, push_raw, push_tagged, task_id: Optional[str] = None):
+    """Push any error-feedback residual as ONE final exact delta and clear
+    it: with few pushes per task (e.g. ``frequency='epoch'``, one epoch)
+    most of the delta mass would otherwise die with the client. Shared by
+    :class:`CompressingClient` and the native binary client — one flush
+    contract to keep in sync, not two."""
+    residual = getattr(codec, "residual", None)
+    if residual is not None and any(
+        r.size and np.abs(r).max() > 0 for r in residual
+    ):
+        if task_id is not None:
+            push_tagged(task_id, residual)
+        else:
+            push_raw(residual)
+        codec.residual = None
+
+
 # -- client wrapper -----------------------------------------------------------
 
 
@@ -150,24 +167,13 @@ class CompressingClient:
         self._inner.update_parameters(self._codec.encode(delta))
 
     def register_attempt(self, task_id, attempt):
-        return self._inner.register_attempt(task_id, attempt)
+        ok = self._inner.register_attempt(task_id, attempt)
+        if ok:
+            self._tagged = True
+        return ok
 
     def update_parameters_tagged(self, task_id, delta):
         self._inner.update_parameters_tagged(task_id, self._codec.encode(delta))
-
-    def _flush_residual(self, task_id=None):
-        """Push any error-feedback residual as one final exact delta: with
-        few pushes per task (e.g. frequency='epoch', epochs=1) most of the
-        delta mass would otherwise die with the client."""
-        residual = getattr(self._codec, "residual", None)
-        if residual is not None and any(
-            r.size and np.abs(r).max() > 0 for r in residual
-        ):
-            if task_id is not None:
-                self._inner.update_parameters_tagged(task_id, residual)
-            else:
-                self._inner.update_parameters(residual)
-            self._codec.residual = None
 
     def commit_attempt(self, task_id):
         # Flush BEFORE committing, tagged with the task: if the flush (or
@@ -175,11 +181,17 @@ class CompressingClient:
         # rollback erases everything — exactly-once is preserved. Flushing
         # after commit would leave a window where a failed untagged flush
         # retries on top of committed pushes.
-        self._flush_residual(task_id)
+        flush_residual(self._codec, self._inner.update_parameters,
+                       self._inner.update_parameters_tagged, task_id)
         self._inner.commit_attempt(task_id)
 
     def close(self):
-        # Untagged workflow (no attempt API): best-effort flush on the
-        # success path — consistent with that mode's at-least-once contract.
-        self._flush_residual()
+        # Untagged workflow only: best-effort flush on the success path
+        # (that mode's at-least-once contract). A TAGGED client must NOT
+        # flush here — on the success path commit_attempt already flushed,
+        # so a nonzero residual at close means the attempt FAILED and an
+        # untagged push would escape the retry's rollback (double-apply).
+        if not getattr(self, "_tagged", False):
+            flush_residual(self._codec, self._inner.update_parameters,
+                           self._inner.update_parameters_tagged)
         self._inner.close()

@@ -158,12 +158,19 @@ class NativeClient(BaseParameterClient):
     model), as the wire carries flat float32 buffers only.
     """
 
-    def __init__(self, shapes, dtypes, port: int, host: str = "127.0.0.1"):
+    def __init__(self, shapes, dtypes, port: int, host: str = "127.0.0.1",
+                 codec=None):
         self.shapes = list(shapes)
         self.dtypes = list(dtypes)
         check_f32_safe(self.dtypes)
         self.host = host
         self.port = int(port)
+        # Delta compression (parameter/compression.py codec object, one per
+        # client — top-k error-feedback residual is per-worker state). The
+        # codec's dict form is re-framed onto the binary wire (V/W opcodes)
+        # and decoded to dense f32 server-side.
+        self.codec = codec
+        self._tagged = False  # set once the attempt API is in use
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
 
@@ -208,16 +215,39 @@ class NativeClient(BaseParameterClient):
             parts.append(flat.tobytes())
         return parts
 
-    def _push(self, header: List[bytes], delta: List[np.ndarray]) -> None:
+    def _compressed_payload(self, delta: List[np.ndarray]) -> List[bytes]:
+        """Codec dict → the binary V/W frame set (see ps_server.cpp)."""
+        enc = self.codec.encode(delta)
+        arrays = enc["arrays"]
+        parts = [struct.pack("<I", len(arrays))]
+        if enc["__elephas_codec__"] == "int8":
+            for a in arrays:
+                q = np.ascontiguousarray(a["q"], dtype=np.int8).ravel()
+                parts.append(struct.pack("<BQf", 1, q.size, a["scale"]))
+                parts.append(q.tobytes())
+        else:  # topk
+            for a in arrays:
+                idx = np.ascontiguousarray(a["idx"], dtype="<i8").ravel()
+                vals = np.ascontiguousarray(a["vals"], dtype="<f4").ravel()
+                nelem = int(np.prod(a["shape"])) if a["shape"] else 1
+                parts.append(struct.pack("<BQQ", 2, nelem, idx.size))
+                parts.append(idx.tobytes())
+                parts.append(vals.tobytes())
+        return parts
+
+    def _push(self, header: List[bytes], payload: List[bytes]) -> None:
         with self._lock:
             sock = self._ensure()
-            sock.sendall(b"".join(header + self._delta_payload(delta)))
+            sock.sendall(b"".join(header + payload))
             ack = self._read_exact(sock, 1)
             if ack != b"A":
                 raise ConnectionError(f"native PS bad ack: {ack!r}")
 
     def update_parameters(self, delta: List[np.ndarray]) -> None:
-        self._push([b"U"], delta)
+        if self.codec is not None:
+            self._push([b"V"], self._compressed_payload(delta))
+        else:
+            self._push([b"U"], self._delta_payload(delta))
 
     @staticmethod
     def _task_id_frame(task_id: str) -> List[bytes]:
@@ -259,13 +289,33 @@ class NativeClient(BaseParameterClient):
                 finally:
                     self._sock = None
                 return False
+        self._tagged = True
         return True
 
     def update_parameters_tagged(self, task_id: str,
                                  delta: List[np.ndarray]) -> None:
-        self._push([b"T"] + self._task_id_frame(task_id), delta)
+        if self.codec is not None:
+            self._push([b"W"] + self._task_id_frame(task_id),
+                       self._compressed_payload(delta))
+        else:
+            self._push([b"T"] + self._task_id_frame(task_id),
+                       self._delta_payload(delta))
+
+    def _push_raw(self, delta: List[np.ndarray]) -> None:
+        """Exact f32 push, bypassing the codec (residual flushes)."""
+        self._push([b"U"], self._delta_payload(delta))
+
+    def _push_raw_tagged(self, task_id: str, delta: List[np.ndarray]) -> None:
+        self._push([b"T"] + self._task_id_frame(task_id),
+                   self._delta_payload(delta))
 
     def commit_attempt(self, task_id: str) -> None:
+        from .compression import flush_residual
+
+        # flush BEFORE committing, tagged: a failed flush fails the task
+        # pre-commit and rollback erases everything (exactly-once holds)
+        flush_residual(self.codec, self._push_raw, self._push_raw_tagged,
+                       task_id)
         with self._lock:
             sock = self._ensure()
             sock.sendall(b"".join([b"C"] + self._task_id_frame(task_id)))
@@ -274,6 +324,17 @@ class NativeClient(BaseParameterClient):
                 raise ConnectionError(f"native PS bad ack: {ack!r}")
 
     def close(self) -> None:
+        # Untagged workflow only (see CompressingClient.close): a tagged
+        # client's nonzero residual at close means the attempt FAILED — an
+        # untagged flush would escape the retry's rollback (double-apply).
+        if not self._tagged:
+            from .compression import flush_residual
+
+            try:
+                flush_residual(self.codec, self._push_raw,
+                               self._push_raw_tagged)  # best-effort
+            except Exception:
+                pass
         with self._lock:
             if self._sock is not None:
                 self._sock.close()

@@ -104,15 +104,10 @@ class SparkModel:
         # Only the host async paths have PS traffic to compress; reject the
         # knob anywhere it would be silently ignored.
         if compression:
-            if parameter_server_mode == "native":
-                raise ValueError(
-                    "compression is not supported with the native binary "
-                    "protocol (use 'http' or 'socket')"
-                )
             if comm != "host" or mode == "synchronous":
                 raise ValueError(
                     "compression applies to the host parameter-server "
-                    "paths (asynchronous/hogwild with http or socket); "
+                    "paths (asynchronous/hogwild with http/socket/native); "
                     f"mode={mode!r} with comm={comm!r} has no PS "
                     "traffic to compress"
                 )
@@ -405,12 +400,16 @@ class SparkModel:
 
     def _make_client(self) -> BaseParameterClient:
         if self.parameter_server_mode == "native":
+            from .parameter.compression import make_codec
             from .parameter.native import NativeClient
 
             weights = self._master_network.get_weights()
             return NativeClient(
                 [w.shape for w in weights], [w.dtype for w in weights],
                 self.port,
+                # fresh codec per client: top-k error-feedback residual is
+                # per-worker state (mirrors the http/socket wrapper below)
+                codec=make_codec(self.compression),
             )
         client = BaseParameterClient.get_client(
             self.parameter_server_mode, self.port, host="127.0.0.1"

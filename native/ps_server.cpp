@@ -15,6 +15,15 @@
 //   'T' u32 len, id[], <U payload>        -> tagged update (accumulated
 //                                            under the task record); reply 'A'
 //   'C' u32 len, id[]                     -> commit (drop record); reply 'A'
+//   'V' <compressed payload>              -> compressed update; reply 'A'
+//   'W' u32 len, id[], <compressed>       -> tagged compressed; reply 'A'
+//
+// Compressed payload (the python Int8/TopK codecs' wire form — decoded to
+// dense f32 here, so compressed and raw clients interoperate):
+//   u32 n_arrays, then per array u8 kind:
+//     0 raw:  u64 nelem, f32[nelem]
+//     1 int8: u64 nelem, f32 scale, i8[nelem]       (delta = q * scale)
+//     2 topk: u64 nelem, u64 nnz, i64 idx[nnz], f32 vals[nnz]
 //
 // The R/T/C opcodes are the exactly-once retry extension, mirroring the
 // Python servers (elephas_tpu/parameter/server.py register_attempt /
@@ -214,6 +223,52 @@ bool write_weight_lists(int fd, const std::vector<std::vector<float>>& arrays) {
   return true;
 }
 
+bool read_compressed_lists(int fd, std::vector<std::vector<float>>* out,
+                           const std::atomic<bool>* running) {
+  uint32_t n_arrays = 0;
+  if (!read_exact(fd, &n_arrays, sizeof(n_arrays), running)) return false;
+  if (n_arrays > 100000) return false;  // sanity bound
+  out->resize(n_arrays);
+  for (uint32_t i = 0; i < n_arrays; ++i) {
+    uint8_t kind = 0;
+    if (!read_exact(fd, &kind, sizeof(kind), running)) return false;
+    uint64_t nelem = 0;
+    if (!read_exact(fd, &nelem, sizeof(nelem), running)) return false;
+    if (nelem > (1ull << 34)) return false;
+    auto& dst = (*out)[i];
+    dst.assign(nelem, 0.0f);
+    if (kind == 0) {
+      if (!read_exact(fd, dst.data(), nelem * sizeof(float), running))
+        return false;
+    } else if (kind == 1) {
+      float scale = 0.0f;
+      if (!read_exact(fd, &scale, sizeof(scale), running)) return false;
+      std::vector<int8_t> q(nelem);
+      if (!read_exact(fd, q.data(), nelem, running)) return false;
+      for (uint64_t j = 0; j < nelem; ++j)
+        dst[j] = static_cast<float>(q[j]) * scale;
+    } else if (kind == 2) {
+      uint64_t nnz = 0;
+      if (!read_exact(fd, &nnz, sizeof(nnz), running)) return false;
+      if (nnz > nelem) return false;
+      std::vector<int64_t> idx(nnz);
+      std::vector<float> vals(nnz);
+      if (!read_exact(fd, idx.data(), nnz * sizeof(int64_t), running))
+        return false;
+      if (!read_exact(fd, vals.data(), nnz * sizeof(float), running))
+        return false;
+      for (uint64_t j = 0; j < nnz; ++j) {
+        if (idx[j] < 0 || static_cast<uint64_t>(idx[j]) >= nelem)
+          return false;
+        dst[static_cast<uint64_t>(idx[j])] = vals[j];
+      }
+    } else {
+      return false;
+    }
+  }
+  return true;
+}
+
 bool read_task_id(int fd, std::string* out, const std::atomic<bool>* running) {
   uint32_t len = 0;
   if (!read_exact(fd, &len, sizeof(len), running)) return false;
@@ -259,6 +314,20 @@ void serve_connection(Server* s, int fd) {
       std::string task_id;
       if (!read_task_id(fd, &task_id, &s->running)) break;
       s->store.commit_attempt(task_id);
+      char ack = 'A';
+      if (!write_exact(fd, &ack, 1)) break;
+    } else if (op == 'V') {
+      std::vector<std::vector<float>> delta;
+      if (!read_compressed_lists(fd, &delta, &s->running)) break;
+      s->store.apply_delta(delta);
+      char ack = 'A';
+      if (!write_exact(fd, &ack, 1)) break;
+    } else if (op == 'W') {
+      std::string task_id;
+      if (!read_task_id(fd, &task_id, &s->running)) break;
+      std::vector<std::vector<float>> delta;
+      if (!read_compressed_lists(fd, &delta, &s->running)) break;
+      s->store.apply_delta(delta, &task_id);
       char ack = 'A';
       if (!write_exact(fd, &ack, 1)) break;
     } else {

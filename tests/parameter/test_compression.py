@@ -101,12 +101,14 @@ def test_compressed_and_plain_clients_share_a_server():
         server.stop()
 
 
-def test_compression_rejected_for_native_protocol(classifier_factory):
+def test_compression_accepted_for_native_protocol(classifier_factory):
+    """The native binary protocol carries compressed deltas too (V/W
+    opcodes) — the construction must accept it like http/socket."""
     from elephas_tpu import SparkModel
 
-    with pytest.raises(ValueError, match="native"):
-        SparkModel(classifier_factory(), mode="asynchronous",
-                   parameter_server_mode="native", compression="int8")
+    sm = SparkModel(classifier_factory(), mode="asynchronous",
+                    parameter_server_mode="native", compression="int8")
+    assert sm.compression == "int8"
 
 
 def test_bad_compression_spec_rejected_eagerly(classifier_factory):
@@ -215,3 +217,30 @@ def test_compressed_async_fit_still_learns(
     sm.fit(rdd, epochs=4, batch_size=32, verbose=0, validation_split=0.0)
     acc = (sm.predict(x).argmax(1) == y.argmax(1)).mean()
     assert acc > 0.5, (spec, acc)
+
+
+def test_tagged_client_close_does_not_flush_residual():
+    """A tagged client's nonzero residual at close() means the attempt
+    FAILED (commit flushes on success) — close must NOT push it untagged,
+    or the stray mass escapes the retry's rollback and double-applies."""
+    w0 = [np.zeros((10, 10))]
+    server = HttpServer([w.copy() for w in w0], mode="asynchronous", port=0)
+    server.start()
+    try:
+        comp = CompressingClient(
+            BaseParameterClient.get_client("http", port=server.port,
+                                           host="127.0.0.1"),
+            make_codec("topk:0.1"),
+        )
+        assert comp.register_attempt("task-x", 0)
+        delta = [np.arange(1.0, 101.0, dtype=np.float32).reshape(10, 10)]
+        comp.update_parameters_tagged("task-x", delta)  # leaves a residual
+        comp.close()  # simulated failure path: NO commit happened
+        # retry rolls the whole attempt back → weights must be pristine
+        retry = BaseParameterClient.get_client("http", port=server.port,
+                                               host="127.0.0.1")
+        assert retry.register_attempt("task-x", 1)
+        np.testing.assert_allclose(server.get_weights()[0], 0.0, atol=1e-7)
+        retry.close()
+    finally:
+        server.stop()

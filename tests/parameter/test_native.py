@@ -91,3 +91,103 @@ def test_spark_model_native_ps(spark_context, toy_classification):
         (sm.master_network.predict(x, verbose=0).argmax(1) == y.argmax(1)).mean()
     )
     assert acc > max(base, 0.34)
+
+
+def test_compressed_pushes_int8_and_topk():
+    """V/W opcodes: codec frames decode to dense f32 server-side; int8 is
+    exact within quantization error and top-k error feedback converges."""
+    import pytest
+
+    from elephas_tpu.parameter.compression import make_codec
+    from elephas_tpu.parameter.native import (NativeClient, NativeServer,
+                                              native_available)
+
+    if not native_available():
+        pytest.skip("native toolchain unavailable")
+
+    w0 = [np.zeros((64,), "float32"), np.full((4, 4), 5.0, "float32")]
+    server = NativeServer([w.copy() for w in w0], port=0)
+    server.start()
+    try:
+        shapes = [w.shape for w in w0]
+        dts = ["float32"] * 2
+
+        # int8: weights -= decode(encode(delta)); error bounded by scale/2
+        c8 = NativeClient(shapes, dts, server.port,
+                          codec=make_codec("int8"))
+        delta = [np.linspace(-1, 1, 64).astype("float32"),
+                 np.full((4, 4), 0.25, "float32")]
+        c8.update_parameters(delta)
+        got = c8.get_parameters()
+        for g, w, d in zip(got, w0, delta):
+            scale = np.abs(d).max() / 127.0
+            np.testing.assert_allclose(g, w - d, atol=scale / 2 + 1e-7)
+        c8.close()
+
+        # topk with error feedback: repeated pushes of the same delta
+        # deliver (approximately) the full mass over time
+        ck = NativeClient(shapes, dts, server.port,
+                          codec=make_codec("topk:0.25"))
+        before = ck.get_parameters()
+        d = [np.arange(64, dtype="float32") / 64.0,
+             np.zeros((4, 4), "float32")]
+        for _ in range(8):
+            ck.update_parameters(d)
+        after = ck.get_parameters()
+        applied = before[0] - after[0]
+        # ≥ the mass of ~6 full pushes must have landed (feedback catches up)
+        assert float(applied.sum()) > 6 * float(d[0].sum()), applied.sum()
+        ck.close()
+
+        # tagged compressed pushes roll back exactly-once on retry
+        # (baseline re-read AFTER ck.close() — close flushes its residual)
+        ct = NativeClient(shapes, dts, server.port,
+                          codec=make_codec("int8"))
+        base = ct.get_parameters()
+        assert ct.register_attempt("t-0", 0)
+        ct.update_parameters_tagged("t-0", [np.full((64,), 100.0, "float32"),
+                                            np.zeros((4, 4), "float32")])
+        snap_poisoned = ct.get_parameters()
+        assert not np.allclose(snap_poisoned[0], base[0])
+        assert ct.register_attempt("t-0", 1)  # retry → poison rolled back
+        clean = ct.get_parameters()
+        np.testing.assert_allclose(clean[0], base[0], atol=1e-5)
+        ct.close()
+    finally:
+        server.stop()
+
+
+def test_native_topk_residual_flush_on_close_and_commit():
+    """Residual flush parity with CompressingClient: one push + close (or
+    commit) delivers the FULL delta through the native wire."""
+    import pytest
+
+    from elephas_tpu.parameter.compression import make_codec
+    from elephas_tpu.parameter.native import (NativeClient, NativeServer,
+                                              native_available)
+
+    if not native_available():
+        pytest.skip("native toolchain unavailable")
+
+    w0 = [np.zeros((100,), "float32")]
+    delta = [np.arange(1.0, 101.0, dtype="float32")]
+
+    for tagged in (False, True):
+        server = NativeServer([w.copy() for w in w0], port=0)
+        server.start()
+        try:
+            c = NativeClient([(100,)], ["float32"], server.port,
+                             codec=make_codec("topk:0.1"))
+            if tagged:
+                assert c.register_attempt("t-0", 0)
+                c.update_parameters_tagged("t-0", delta)
+                c.commit_attempt("t-0")  # flush rides the attempt record
+            else:
+                c.update_parameters(delta)
+                c.close()                # best-effort flush
+            np.testing.assert_allclose(server.get_weights()[0], -delta[0],
+                                       atol=1e-5)
+            if tagged:
+                assert server.attempt_count() == 0
+        finally:
+            server.stop()
