@@ -207,13 +207,27 @@ class TransformerLM:
         return shard_by_specs(mesh, self.specs(), params)
 
     # ------------------------------------------------------------------
-    def _attend(self, q, k, v, attn: str, seq_axis: str):
+    def _attend(self, q, k, v, attn: str, seq_axis: str, rope=None,
+                rope_tables=None):
+        """``rope=(cos, sin)`` is only ever non-None on the ``"flash"``
+        path (see ``_block_fwd``): on TPU the rotation fuses into the
+        Pallas kernels via ``rope_tables`` (the duplicated C2/S2 tables,
+        built ONCE per forward in ``apply_with_aux`` — building them here
+        would re-materialize them every scanned layer); elsewhere it is
+        applied here before the scan."""
         if attn == "dense":
             return attention_reference(q, k, v, causal=True)
         if attn == "flash":
             # Blockwise exact attention (custom-VJP flash fwd+bwd): no
             # [T, T] materialization in either direction. Single-shard
             # sequence only — the sp>1 equivalents are ring/ulysses.
+            if rope_tables is not None:
+                from ..ops.pallas_flash import flash_attention_rope
+
+                return flash_attention_rope(q, k, v, *rope_tables, True)
+            if rope is not None:
+                q = _rope_rotate(q, *rope)
+                k = _rope_rotate(k, *rope)
             return flash_attention(q, k, v, causal=True)
         if attn == "ring":
             return ring_attention_local(q, k, v, causal=True,
@@ -238,11 +252,22 @@ class TransformerLM:
         term)."""
         h = self._embed(params, tokens, positions)
         rope = self._rope_for(positions)
+        # Fused-rope tables are built ONCE here — inside the scanned layer
+        # body XLA could not hoist them, re-materializing [B, T, Dh] f32
+        # pairs every layer.
+        tables = None
+        if rope is not None and attn == "flash" and is_tpu_backend():
+            from ..ops.pallas_flash import make_rope_tables
+
+            cos, sin = rope
+            tables = make_rope_tables(cos[..., 0, :], sin[..., 0, :])
 
         def block(h, lp):
             h, aux, _, _ = self._block_fwd(
                 h, lp,
-                lambda q, k, v: self._attend(q, k, v, attn, seq_axis),
+                lambda q, k, v, rp=None: self._attend(
+                    q, k, v, attn, seq_axis, rope=rp, rope_tables=tables
+                ),
                 attn, seq_axis, rope=rope,
             )
             return h, aux
@@ -302,10 +327,18 @@ class TransformerLM:
         q = (x @ lp["wq"].astype(cd)).reshape(B, T, H, Dh)
         k = (x @ lp["wk"].astype(cd)).reshape(B, T, Hkv, Dh)
         v = (x @ lp["wv"].astype(cd)).reshape(B, T, Hkv, Dh)
-        if rope is not None:
-            q = _rope_rotate(q, *rope)
+        if rope is not None and attn == "flash":
+            # rotation happens inside the flash attend (fused into the
+            # Pallas kernels on TPU — rotated q/k never hit HBM). The
+            # RETURNED k still carries the rotation for cache consumers;
+            # XLA removes it when the training scan discards k.
+            a = attend(q, k, v, rope).astype(cd)
             k = _rope_rotate(k, *rope)
-        a = attend(q, k, v).astype(cd)  # ops broadcast KV heads as needed
+        else:
+            if rope is not None:
+                q = _rope_rotate(q, *rope)
+                k = _rope_rotate(k, *rope)
+            a = attend(q, k, v).astype(cd)  # ops broadcast KV heads as needed
         h = h + a.reshape(B, T, self.d_model) @ lp["wo"].astype(cd)
         x = _layer_norm(
             h.astype(jnp.float32), lp["ln2_s"], lp["ln2_b"]

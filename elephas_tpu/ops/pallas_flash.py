@@ -92,9 +92,33 @@ def _mask_t(sT, causal: bool, i, j, bq: int, bk: int, t_true: int):
 # -- forward ------------------------------------------------------------------
 
 
+def _roll_half(x):
+    """Swap the two lane-halves: ``[x1, x2] → [x2, x1]`` (RoPE helper)."""
+    h = x.shape[-1] // 2
+    return jnp.concatenate([x[..., h:], x[..., :h]], axis=-1)
+
+
+def _rot(x, c2, s2, neg: bool = False):
+    """Half-split RoPE as ``x·C2 + roll(x)·S2`` with ``C2 = [cos|cos]``,
+    ``S2 = [−sin|sin]`` (both [tiles, Dh] f32). ``neg=True`` applies the
+    INVERSE rotation (derotation — the transform is orthogonal), used to
+    map the backward kernels' d(q_rot)/d(k_rot) back to dq/dk. Rotation in
+    f32, result in ``x``'s dtype (same contract as the jnp `_rope_rotate`).
+    """
+    xf = x.astype(jnp.float32)
+    s2 = -s2 if neg else s2
+    return (xf * c2 + _roll_half(xf) * s2).astype(x.dtype)
+
+
 def _fwd_kernel(causal: bool, bq: int, bk: int, t_true: int, scale: float,
-                q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s):
+                rope: bool, *refs):
     from jax.experimental import pallas as pl
+
+    if rope:
+        (q_ref, k_ref, v_ref, cq_ref, sq_ref, ck_ref, sk_ref,
+         o_ref, lse_ref, m_s, l_s, acc_s, qr_s) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s = refs
 
     i, j = pl.program_id(2), pl.program_id(3)
 
@@ -103,11 +127,19 @@ def _fwd_kernel(causal: bool, bq: int, bk: int, t_true: int, scale: float,
         m_s[:] = jnp.full_like(m_s, _NEG)
         l_s[:] = jnp.zeros_like(l_s)
         acc_s[:] = jnp.zeros_like(acc_s)
+        if rope:
+            # q is invariant across the KV sweep: rotate ONCE per window
+            qr_s[:] = _rot(q_ref[0, 0].astype(jnp.float32),
+                           cq_ref[0], sq_ref[0])
 
     @pl.when(_visible(causal, i, j, bq, bk))
     def _compute():
-        q = q_ref[0, 0]                      # [bq, Dh]
-        k = k_ref[0, 0]                      # [bk, Dh]
+        if rope:
+            q = qr_s[:].astype(q_ref.dtype)
+            k = _rot(k_ref[0, 0], ck_ref[0], sk_ref[0])
+        else:
+            q = q_ref[0, 0]                  # [bq, Dh]
+            k = k_ref[0, 0]                  # [bk, Dh]
         prec = _prec(q_ref, k_ref)
         sT = jax.lax.dot_general(            # k-major scores [bk, bq]
             k, q, (((1,), (1,)), ((), ())),
@@ -137,8 +169,19 @@ def _fwd_kernel(causal: bool, bq: int, bk: int, t_true: int, scale: float,
                                          lse_ref[0, 0].shape)
 
 
-def _flash_fwd_tpu(q, k, v, causal, bq, bk, interpret):
-    """``q`` [B, H, T, Dh]; ``k``/``v`` [B, Hkv, T, Dh] → (o, lse)."""
+def _pad_t(a, Tp, T):
+    return a if Tp == T else jnp.pad(
+        a, ((0, 0),) * (a.ndim - 2) + ((0, Tp - T), (0, 0))
+    )
+
+
+def _flash_fwd_tpu(q, k, v, causal, bq, bk, interpret, rope=None):
+    """``q`` [B, H, T, Dh]; ``k``/``v`` [B, Hkv, T, Dh] → (o, lse).
+
+    ``rope=(c2, s2)`` ([B, T, Dh] f32, the duplicated half-split tables)
+    fuses the rotary embedding of q and k into the kernel — the rotated
+    tensors never exist in HBM.
+    """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -160,18 +203,33 @@ def _flash_fwd_tpu(q, k, v, causal, bq, bk, interpret):
     if causal:
         kv_ix = lambda b, h, i, j: (
             b, h // G, jnp.minimum(j, (i * bq + bq - 1) // bk), 0)
+        rk_ix = lambda b, h, i, j: (
+            b, jnp.minimum(j, (i * bq + bq - 1) // bk), 0)
     else:
         kv_ix = lambda b, h, i, j: (b, h // G, j, 0)
+        rk_ix = lambda b, h, i, j: (b, j, 0)
+    rq_ix = lambda b, h, i, j: (b, i, 0)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, Dh), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, bk, Dh), kv_ix),
+        pl.BlockSpec((1, 1, bk, Dh), kv_ix),
+    ]
+    inputs = [q, k, v]
+    if rope is not None:
+        c2, s2 = (_pad_t(t, max(Tq, Tk), T) for t in rope)
+        in_specs += [pl.BlockSpec((1, bq, Dh), rq_ix),
+                     pl.BlockSpec((1, bq, Dh), rq_ix),
+                     pl.BlockSpec((1, bk, Dh), rk_ix),
+                     pl.BlockSpec((1, bk, Dh), rk_ix)]
+        inputs += [c2, s2, c2, s2]
 
     grid = (B, H, nq, nk)
     o, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, causal, bq, bk, T, scale),
+        functools.partial(_fwd_kernel, causal, bq, bk, T, scale,
+                          rope is not None),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, Dh), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bk, Dh), kv_ix),
-            pl.BlockSpec((1, 1, bk, Dh), kv_ix),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, Dh), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, 8, bq), lambda b, h, i, j: (b, h, 0, i)),
@@ -184,9 +242,10 @@ def _flash_fwd_tpu(q, k, v, causal, bq, bk, interpret):
             pltpu.VMEM((8, bq), jnp.float32),    # running max (row 0 live)
             pltpu.VMEM((8, bq), jnp.float32),    # running denominator
             pltpu.VMEM((Dh, bq), jnp.float32),   # transposed accumulator
-        ],
+        ] + ([pltpu.VMEM((bq, Dh), jnp.float32)]  # rotated-q (per window)
+             if rope is not None else []),
         interpret=interpret,
-    )(q, k, v)
+    )(*inputs)
     return o[:, :, :T], lse[:, :, :, :T]
 
 
@@ -194,19 +253,33 @@ def _flash_fwd_tpu(q, k, v, causal, bq, bk, interpret):
 
 
 def _dq_kernel(causal: bool, bq: int, bk: int, t_true: int, scale: float,
-               q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref, dq_s):
+               rope: bool, *refs):
     from jax.experimental import pallas as pl
+
+    if rope:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+         cq_ref, sq_ref, ck_ref, sk_ref, dq_ref, dq_s, qr_s) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+         dq_ref, dq_s) = refs
 
     i, j = pl.program_id(2), pl.program_id(3)
 
     @pl.when(j == 0)
     def _init():
         dq_s[:] = jnp.zeros_like(dq_s)
+        if rope:
+            qr_s[:] = _rot(q_ref[0, 0].astype(jnp.float32),
+                           cq_ref[0], sq_ref[0])
 
     @pl.when(_visible(causal, i, j, bq, bk))
     def _compute():
-        q = q_ref[0, 0]                      # [bq, Dh]
-        k = k_ref[0, 0]                      # [bk, Dh]
+        if rope:
+            q = qr_s[:].astype(q_ref.dtype)
+            k = _rot(k_ref[0, 0], ck_ref[0], sk_ref[0])
+        else:
+            q = q_ref[0, 0]                  # [bq, Dh]
+            k = k_ref[0, 0]                  # [bk, Dh]
         v = v_ref[0, 0]
         do = do_ref[0, 0]                    # [bq, Dh]
         prec = _prec(q_ref, k_ref)
@@ -228,13 +301,24 @@ def _dq_kernel(causal: bool, bq: int, bk: int, t_true: int, scale: float,
 
     @pl.when(j == pl.num_programs(3) - 1)
     def _finish():
-        dq_ref[0, 0] = jnp.transpose(dq_s[:]).astype(dq_ref.dtype)
+        dq = jnp.transpose(dq_s[:])          # [bq, Dh] f32, w.r.t. q_rot
+        if rope:
+            # derotate (inverse rotation): d/dq = R(−θ) · d/d(q_rot)
+            dq = _rot(dq, cq_ref[0], sq_ref[0], neg=True)
+        dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
 def _dkv_kernel(causal: bool, bq: int, bk: int, t_true: int, scale: float,
-                q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
-                dk_ref, dv_ref, dk_s, dv_s):
+                rope: bool, *refs):
     from jax.experimental import pallas as pl
+
+    if rope:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+         cq_ref, sq_ref, ck_ref, sk_ref, dk_ref, dv_ref, dk_s, dv_s,
+         kr_s) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+         dk_ref, dv_ref, dk_s, dv_s) = refs
 
     j, i = pl.program_id(2), pl.program_id(3)   # KV tile outer, Q inner
 
@@ -242,11 +326,19 @@ def _dkv_kernel(causal: bool, bq: int, bk: int, t_true: int, scale: float,
     def _init():
         dk_s[:] = jnp.zeros_like(dk_s)
         dv_s[:] = jnp.zeros_like(dv_s)
+        if rope:
+            # k is invariant across the Q sweep: rotate ONCE per window
+            kr_s[:] = _rot(k_ref[0, 0].astype(jnp.float32),
+                           ck_ref[0], sk_ref[0])
 
     @pl.when(_visible(causal, i, j, bq, bk))
     def _compute():
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
+        if rope:
+            q = _rot(q_ref[0, 0], cq_ref[0], sq_ref[0])
+            k = kr_s[:].astype(k_ref.dtype)
+        else:
+            q = q_ref[0, 0]
+            k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0]
         prec = _prec(q_ref, k_ref)
@@ -273,12 +365,15 @@ def _dkv_kernel(causal: bool, bq: int, bk: int, t_true: int, scale: float,
 
     @pl.when(i == pl.num_programs(3) - 1)
     def _finish():
-        dk_ref[0, 0] = dk_s[:].astype(dk_ref.dtype)
+        dk = dk_s[:]                         # [bk, Dh] f32, w.r.t. k_rot
+        if rope:
+            dk = _rot(dk, ck_ref[0], sk_ref[0], neg=True)
+        dk_ref[0, 0] = dk.astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_s[:].astype(dv_ref.dtype)
 
 
 def _flash_bwd_tpu(q, k, v, o, lse, do, causal, bq, bk, interpret,
-                   delta_minus=None):
+                   delta_minus=None, rope=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -306,6 +401,8 @@ def _flash_bwd_tpu(q, k, v, o, lse, do, causal, bq, bk, interpret,
     if Tk != T:
         pad_k = ((0, 0), (0, 0), (0, Tk - T), (0, 0))
         k, v = jnp.pad(k, pad_k), jnp.pad(v, pad_k)
+    if rope is not None:
+        c2, s2 = (_pad_t(t, max(Tq, Tk), T) for t in rope)
     nq, nk = Tq // bq, Tk // bk
     scale = Dh ** -0.5
 
@@ -316,40 +413,69 @@ def _flash_bwd_tpu(q, k, v, o, lse, do, causal, bq, bk, interpret,
         # q tiles up to the first visible one.
         q_ix = lambda b, h, j, i: (b, h, jnp.maximum(i, (j * bk) // bq), 0)
         q_ix_s = lambda b, h, j, i: (b, h, 0, jnp.maximum(i, (j * bk) // bq))
+        # rope-table maps (3-D [B, T, Dh] tables, no head axis)
+        rkq_ix = lambda b, h, i, j: (
+            b, jnp.minimum(j, (i * bq + bq - 1) // bk), 0)
+        rq_ixq = lambda b, h, i, j: (b, i, 0)
+        rq_ixk = lambda b, h, j, i: (b, jnp.maximum(i, (j * bk) // bq), 0)
+        rk_ixk = lambda b, h, j, i: (b, j, 0)
     else:
         kv_ix = lambda b, h, i, j: (b, h // G, j, 0)
         q_ix = lambda b, h, j, i: (b, h, i, 0)
         q_ix_s = lambda b, h, j, i: (b, h, 0, i)
+        rkq_ix = lambda b, h, i, j: (b, j, 0)
+        rq_ixq = lambda b, h, i, j: (b, i, 0)
+        rq_ixk = lambda b, h, j, i: (b, i, 0)
+        rk_ixk = lambda b, h, j, i: (b, j, 0)
 
+    dq_specs = [
+        pl.BlockSpec((1, 1, bq, Dh), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, bk, Dh), kv_ix),
+        pl.BlockSpec((1, 1, bk, Dh), kv_ix),
+        pl.BlockSpec((1, 1, bq, Dh), lambda b, h, i, j: (b, h, i, 0)),
+        pl.BlockSpec((1, 1, 8, bq), lambda b, h, i, j: (b, h, 0, i)),
+        pl.BlockSpec((1, 1, 8, bq), lambda b, h, i, j: (b, h, 0, i)),
+    ]
+    dq_inputs = [q, k, v, do, lse, delta]
+    if rope is not None:
+        dq_specs += [pl.BlockSpec((1, bq, Dh), rq_ixq),
+                     pl.BlockSpec((1, bq, Dh), rq_ixq),
+                     pl.BlockSpec((1, bk, Dh), rkq_ix),
+                     pl.BlockSpec((1, bk, Dh), rkq_ix)]
+        dq_inputs += [c2, s2, c2, s2]
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, causal, bq, bk, T, scale),
+        functools.partial(_dq_kernel, causal, bq, bk, T, scale,
+                          rope is not None),
         grid=(B, H, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, Dh), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, bk, Dh), kv_ix),
-            pl.BlockSpec((1, 1, bk, Dh), kv_ix),
-            pl.BlockSpec((1, 1, bq, Dh), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, 8, bq), lambda b, h, i, j: (b, h, 0, i)),
-            pl.BlockSpec((1, 1, 8, bq), lambda b, h, i, j: (b, h, 0, i)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, 1, bq, Dh), lambda b, h, i, j: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, Tq, Dh), q.dtype),
-        scratch_shapes=[pltpu.VMEM((Dh, bq), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((Dh, bq), jnp.float32)]
+        + ([pltpu.VMEM((bq, Dh), jnp.float32)] if rope is not None else []),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dq_inputs)
 
     # dk/dv per QUERY head; GQA groups summed below.
+    dkv_specs = [
+        pl.BlockSpec((1, 1, bq, Dh), q_ix),
+        pl.BlockSpec((1, 1, bk, Dh), lambda b, h, j, i: (b, h // G, j, 0)),
+        pl.BlockSpec((1, 1, bk, Dh), lambda b, h, j, i: (b, h // G, j, 0)),
+        pl.BlockSpec((1, 1, bq, Dh), q_ix),
+        pl.BlockSpec((1, 1, 8, bq), q_ix_s),
+        pl.BlockSpec((1, 1, 8, bq), q_ix_s),
+    ]
+    dkv_inputs = [q, k, v, do, lse, delta]
+    if rope is not None:
+        dkv_specs += [pl.BlockSpec((1, bq, Dh), rq_ixk),
+                      pl.BlockSpec((1, bq, Dh), rq_ixk),
+                      pl.BlockSpec((1, bk, Dh), rk_ixk),
+                      pl.BlockSpec((1, bk, Dh), rk_ixk)]
+        dkv_inputs += [c2, s2, c2, s2]
     dkh, dvh = pl.pallas_call(
-        functools.partial(_dkv_kernel, causal, bq, bk, T, scale),
+        functools.partial(_dkv_kernel, causal, bq, bk, T, scale,
+                          rope is not None),
         grid=(B, H, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, Dh), q_ix),
-            pl.BlockSpec((1, 1, bk, Dh), lambda b, h, j, i: (b, h // G, j, 0)),
-            pl.BlockSpec((1, 1, bk, Dh), lambda b, h, j, i: (b, h // G, j, 0)),
-            pl.BlockSpec((1, 1, bq, Dh), q_ix),
-            pl.BlockSpec((1, 1, 8, bq), q_ix_s),
-            pl.BlockSpec((1, 1, 8, bq), q_ix_s),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bk, Dh), lambda b, h, j, i: (b, h, j, 0)),
             pl.BlockSpec((1, 1, bk, Dh), lambda b, h, j, i: (b, h, j, 0)),
@@ -361,9 +487,9 @@ def _flash_bwd_tpu(q, k, v, o, lse, do, causal, bq, bk, interpret,
         scratch_shapes=[
             pltpu.VMEM((bk, Dh), jnp.float32),
             pltpu.VMEM((bk, Dh), jnp.float32),
-        ],
+        ] + ([pltpu.VMEM((bk, Dh), jnp.float32)] if rope is not None else []),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(*dkv_inputs)
 
     dq = dq[:, :, :T]
     dkh, dvh = dkh[:, :, :T], dvh[:, :, :T]
@@ -451,3 +577,71 @@ def _fal_bwd(causal, block_q, block_k, interpret, res, cts):
 
 
 flash_attention_with_lse.defvjp(_fal_fwd, _fal_bwd)
+
+
+def make_rope_tables(cos, sin):
+    """(cos, sin) ``[..., Dh/2]`` → duplicated half-split tables
+    ``(C2, S2)`` ``[..., Dh]`` f32 (see ``_rot``). Build ONCE per forward
+    — inside a scanned layer body XLA cannot hoist the concat, so callers
+    must not rebuild per layer."""
+    c2 = jnp.concatenate([cos, cos], -1).astype(jnp.float32)
+    s2 = jnp.concatenate([-sin, sin], -1).astype(jnp.float32)
+    return c2, s2
+
+
+# -- rope-fused variant (train-path attention with in-kernel rotation) --------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def flash_attention_rope(q, k, v, c2, s2, causal: bool = True,
+                         block_q: int = _BQ, block_k: int = _BK,
+                         interpret: bool = False):
+    """Flash attention with the rotary embedding FUSED into the kernels.
+
+    ``q`` [B, T, H, Dh] and ``k``/``v`` [B, T, Hkv, Dh] arrive UNROTATED;
+    ``c2``/``s2`` are the duplicated half-split RoPE tables ``[B, T, Dh]``
+    float32 (``C2 = [cos|cos]``, ``S2 = [−sin|sin]``, see ``_rot``). The
+    rotated q/k never exist in HBM: tiles rotate on load in the forward
+    AND both backward kernels, and the gradient tiles derotate on store
+    (the rotation is orthogonal, so the VJP is the inverse rotation).
+    Numerically identical to rotating with ``_rope_rotate`` first — for
+    q/k/v gradients. The TABLES are treated as constants (positions are
+    not trained): their cotangent is zero by contract, made explicit with
+    a ``stop_gradient`` — learned-rotary experiments must not route
+    frequency gradients through this op.
+    """
+    (out, _), _res = _far_fwd(q, k, v, c2, s2, causal, block_q, block_k,
+                              interpret)
+    return out
+
+
+def _far_fwd(q, k, v, c2, s2, causal, block_q, block_k, interpret):
+    c2 = jax.lax.stop_gradient(c2)
+    s2 = jax.lax.stop_gradient(s2)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    o, lse = _flash_fwd_tpu(qt, kt, vt, causal, block_q, block_k, interpret,
+                            rope=(c2, s2))
+    return ((jnp.swapaxes(o, 1, 2), lse),
+            (qt, kt, vt, o, lse, c2, s2))
+
+
+def _far_bwd(causal, block_q, block_k, interpret, res, g):
+    qt, kt, vt, o, lse, c2, s2 = res
+    do = jnp.swapaxes(g, 1, 2)
+    dq, dk, dv = _flash_bwd_tpu(qt, kt, vt, o, lse, do, causal,
+                                block_q, block_k, interpret,
+                                rope=(c2, s2))
+    # positions are constants: zero cotangent for the tables (DCE'd)
+    return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
+            jnp.swapaxes(dv, 1, 2), jnp.zeros_like(c2), jnp.zeros_like(s2))
+
+
+def _far_fwd_vjp(q, k, v, c2, s2, causal, block_q, block_k, interpret):
+    (out, _lse), res = _far_fwd(q, k, v, c2, s2, causal, block_q, block_k,
+                                interpret)
+    return out, res
+
+
+flash_attention_rope.defvjp(_far_fwd_vjp, _far_bwd)

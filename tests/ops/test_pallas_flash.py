@@ -196,3 +196,43 @@ def test_ring_with_pallas_kernel_matches_oracle(causal, hkv):
     for name, a, b in zip(("dq", "dk", "dv"), got, ref):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=3e-5, rtol=3e-5, err_msg=name)
+
+
+@pytest.mark.parametrize("hkv,dh,t", [(4, 32, 256), (2, 64, 256), (2, 32, 200)])
+def test_rope_fused_matches_prerotated_oracle(hkv, dh, t):
+    """flash_attention_rope (in-kernel rotation, derotated gradients) must
+    equal rotate-then-attend exactly — forward and all three gradients."""
+    import jax
+
+    from elephas_tpu.models.transformer import _rope_angles, _rope_rotate
+    from elephas_tpu.ops import attention_reference
+    from elephas_tpu.ops.pallas_flash import (flash_attention_rope,
+                                              make_rope_tables)
+
+    rng = np.random.default_rng(11)
+    B, H = 2, 4
+    q = _rand(rng, B, t, H, dh)
+    k = _rand(rng, B, t, hkv, dh)
+    v = _rand(rng, B, t, hkv, dh)
+    g = _rand(rng, B, t, H, dh)
+    positions = jnp.broadcast_to(jnp.arange(t), (B, t))
+    cos, sin = _rope_angles(positions, dh)
+    cos4, sin4 = cos[:, :, None, :], sin[:, :, None, :]
+    c2, s2 = make_rope_tables(cos, sin)
+
+    def ref(q, k, v):
+        return attention_reference(_rope_rotate(q, cos4, sin4),
+                                   _rope_rotate(k, cos4, sin4), v,
+                                   causal=True)
+
+    def ker(q, k, v):
+        return flash_attention_rope(q, k, v, c2, s2, True, 128, 128, True)
+
+    np.testing.assert_allclose(np.asarray(ker(q, k, v)),
+                               np.asarray(ref(q, k, v)),
+                               atol=2e-5, rtol=2e-5)
+    want = jax.vjp(ref, q, k, v)[1](g)
+    got = jax.vjp(ker, q, k, v)[1](g)
+    for name, a, b in zip(("dq", "dk", "dv"), got, want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-5, rtol=2e-5, err_msg=name)
