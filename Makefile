@@ -10,10 +10,10 @@ TEST_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 .PHONY: test test-fast bench
 
 test:
-	$(TEST_ENV) python -m pytest tests/ -x -q
+	$(TEST_ENV) bash scripts/run_tests.sh -x -q
 
 test-fast:
-	$(TEST_ENV) python -m pytest tests/ -x -q -m "not slow"
+	$(TEST_ENV) bash scripts/run_tests.sh -x -q -m "not slow"
 
 bench:
 	KERAS_BACKEND=jax python bench.py

@@ -192,19 +192,37 @@ class NativeClient(BaseParameterClient):
             n -= len(chunk)
         return b"".join(chunks)
 
+    def _reset_socket(self) -> None:
+        """Drop a possibly-desynced connection so the next call reconnects.
+
+        A timed-out or half-read exchange leaves unread bytes in the stream;
+        reusing the socket would let a stale ack byte be parsed as part of a
+        later length field, producing confusing failures far from the cause.
+        """
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            finally:
+                self._sock = None
+
     def get_parameters(self) -> List[np.ndarray]:
         with self._lock:
             sock = self._ensure()
-            sock.sendall(b"G")
-            (n_arrays,) = struct.unpack("<I", self._read_exact(sock, 4))
-            out = []
-            for i in range(n_arrays):
-                (nelem,) = struct.unpack("<Q", self._read_exact(sock, 8))
-                buf = np.frombuffer(
-                    self._read_exact(sock, int(nelem) * 4), dtype="<f4"
-                )
-                out.append(buf.reshape(self.shapes[i]).astype(self.dtypes[i]))
-            return out
+            try:
+                sock.sendall(b"G")
+                (n_arrays,) = struct.unpack("<I", self._read_exact(sock, 4))
+                out = []
+                for i in range(n_arrays):
+                    (nelem,) = struct.unpack("<Q", self._read_exact(sock, 8))
+                    buf = np.frombuffer(
+                        self._read_exact(sock, int(nelem) * 4), dtype="<f4"
+                    )
+                    out.append(
+                        buf.reshape(self.shapes[i]).astype(self.dtypes[i]))
+                return out
+            except Exception:
+                self._reset_socket()
+                raise
 
     @staticmethod
     def _delta_payload(delta: List[np.ndarray]) -> List[bytes]:
@@ -238,9 +256,14 @@ class NativeClient(BaseParameterClient):
     def _push(self, header: List[bytes], payload: List[bytes]) -> None:
         with self._lock:
             sock = self._ensure()
-            sock.sendall(b"".join(header + payload))
-            ack = self._read_exact(sock, 1)
+            try:
+                sock.sendall(b"".join(header + payload))
+                ack = self._read_exact(sock, 1)
+            except Exception:
+                self._reset_socket()
+                raise
             if ack != b"A":
+                self._reset_socket()
                 raise ConnectionError(f"native PS bad ack: {ack!r}")
 
     def update_parameters(self, delta: List[np.ndarray]) -> None:
@@ -278,16 +301,10 @@ class NativeClient(BaseParameterClient):
                 # direction is to fail the attempt (task retry handles it).
                 # Every shipped native server implements the extension;
                 # pre-extension servers are not supported for degradation.
-                try:
-                    sock.close()
-                finally:
-                    self._sock = None
+                self._reset_socket()
                 raise
             if ack != b"k":
-                try:
-                    sock.close()
-                finally:
-                    self._sock = None
+                self._reset_socket()
                 return False
         self._tagged = True
         return True

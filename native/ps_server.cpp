@@ -154,6 +154,15 @@ struct WeightStore {
     std::lock_guard<std::mutex> lock(mu);
     return arrays;
   }
+
+  // Per-array element counts, for bounding incoming frame sizes: a pushed
+  // delta can never legitimately be larger than the weights it updates.
+  std::vector<uint64_t> elem_bounds() {
+    std::lock_guard<std::mutex> lock(mu);
+    std::vector<uint64_t> out(arrays.size());
+    for (size_t i = 0; i < arrays.size(); ++i) out[i] = arrays[i].size();
+    return out;
+  }
 };
 
 struct Server {
@@ -195,8 +204,18 @@ bool write_exact(int fd, const void* buf, size_t n) {
   return true;
 }
 
+// A delta array can never legitimately exceed the weights it updates, so a
+// corrupt or desynced frame claiming a huge nelem is rejected before the
+// allocation instead of OOM-ing the connection thread. bounds is empty only
+// before eps_set_weights, where the permissive legacy cap applies.
+bool nelem_ok(uint32_t i, uint64_t nelem, const std::vector<uint64_t>& bounds) {
+  if (bounds.empty()) return nelem <= (1ull << 34);
+  return i < bounds.size() && nelem <= bounds[i];
+}
+
 bool read_weight_lists(int fd, std::vector<std::vector<float>>* out,
-                       const std::atomic<bool>* running) {
+                       const std::atomic<bool>* running,
+                       const std::vector<uint64_t>& bounds) {
   uint32_t n_arrays = 0;
   if (!read_exact(fd, &n_arrays, sizeof(n_arrays), running)) return false;
   if (n_arrays > 100000) return false;  // sanity bound
@@ -204,7 +223,7 @@ bool read_weight_lists(int fd, std::vector<std::vector<float>>* out,
   for (uint32_t i = 0; i < n_arrays; ++i) {
     uint64_t nelem = 0;
     if (!read_exact(fd, &nelem, sizeof(nelem), running)) return false;
-    if (nelem > (1ull << 34)) return false;  // 16B floats * 4 = 64GB cap
+    if (!nelem_ok(i, nelem, bounds)) return false;
     (*out)[i].resize(nelem);
     if (!read_exact(fd, (*out)[i].data(), nelem * sizeof(float), running))
       return false;
@@ -224,7 +243,8 @@ bool write_weight_lists(int fd, const std::vector<std::vector<float>>& arrays) {
 }
 
 bool read_compressed_lists(int fd, std::vector<std::vector<float>>* out,
-                           const std::atomic<bool>* running) {
+                           const std::atomic<bool>* running,
+                           const std::vector<uint64_t>& bounds) {
   uint32_t n_arrays = 0;
   if (!read_exact(fd, &n_arrays, sizeof(n_arrays), running)) return false;
   if (n_arrays > 100000) return false;  // sanity bound
@@ -234,7 +254,7 @@ bool read_compressed_lists(int fd, std::vector<std::vector<float>>* out,
     if (!read_exact(fd, &kind, sizeof(kind), running)) return false;
     uint64_t nelem = 0;
     if (!read_exact(fd, &nelem, sizeof(nelem), running)) return false;
-    if (nelem > (1ull << 34)) return false;
+    if (!nelem_ok(i, nelem, bounds)) return false;
     auto& dst = (*out)[i];
     dst.assign(nelem, 0.0f);
     if (kind == 0) {
@@ -277,20 +297,19 @@ bool read_task_id(int fd, std::string* out, const std::atomic<bool>* running) {
   return read_exact(fd, out->data(), len, running);
 }
 
-void serve_connection(Server* s, int fd) {
-  int one = 1;
-  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
-  timeval tv{0, 200000};  // 200ms — lets threads notice eps_stop()
-  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+void serve_connection_loop(Server* s, int fd) {
   while (s->running.load()) {
     char op = 0;
     if (!read_exact(fd, &op, 1, &s->running)) break;
+    // Re-read per op: cheap (a short vector copy under the lock), and stays
+    // correct if eps_set_weights resizes the store mid-connection.
+    const std::vector<uint64_t> bounds = s->store.elem_bounds();
     if (op == 'G') {
       auto snap = s->store.snapshot();
       if (!write_weight_lists(fd, snap)) break;
     } else if (op == 'U') {
       std::vector<std::vector<float>> delta;
-      if (!read_weight_lists(fd, &delta, &s->running)) break;
+      if (!read_weight_lists(fd, &delta, &s->running, bounds)) break;
       s->store.apply_delta(delta);
       char ack = 'A';
       if (!write_exact(fd, &ack, 1)) break;
@@ -306,7 +325,7 @@ void serve_connection(Server* s, int fd) {
       std::string task_id;
       if (!read_task_id(fd, &task_id, &s->running)) break;
       std::vector<std::vector<float>> delta;
-      if (!read_weight_lists(fd, &delta, &s->running)) break;
+      if (!read_weight_lists(fd, &delta, &s->running, bounds)) break;
       s->store.apply_delta(delta, &task_id);
       char ack = 'A';
       if (!write_exact(fd, &ack, 1)) break;
@@ -318,7 +337,7 @@ void serve_connection(Server* s, int fd) {
       if (!write_exact(fd, &ack, 1)) break;
     } else if (op == 'V') {
       std::vector<std::vector<float>> delta;
-      if (!read_compressed_lists(fd, &delta, &s->running)) break;
+      if (!read_compressed_lists(fd, &delta, &s->running, bounds)) break;
       s->store.apply_delta(delta);
       char ack = 'A';
       if (!write_exact(fd, &ack, 1)) break;
@@ -326,13 +345,26 @@ void serve_connection(Server* s, int fd) {
       std::string task_id;
       if (!read_task_id(fd, &task_id, &s->running)) break;
       std::vector<std::vector<float>> delta;
-      if (!read_compressed_lists(fd, &delta, &s->running)) break;
+      if (!read_compressed_lists(fd, &delta, &s->running, bounds)) break;
       s->store.apply_delta(delta, &task_id);
       char ack = 'A';
       if (!write_exact(fd, &ack, 1)) break;
     } else {
       break;
     }
+  }
+}
+
+void serve_connection(Server* s, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  timeval tv{0, 200000};  // 200ms — lets threads notice eps_stop()
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  try {
+    serve_connection_loop(s, fd);
+  } catch (const std::exception&) {
+    // A corrupt frame that slipped past the bounds (or genuine allocation
+    // pressure) costs this one connection, never the training process.
   }
   ::close(fd);
 }

@@ -31,6 +31,7 @@ EXAMPLES = [
 
 
 @pytest.mark.slow
+@pytest.mark.timeout(900)  # resnet50 measures ~134s locally; 900 covers CI
 @pytest.mark.parametrize("script", EXAMPLES)
 def test_example_runs(script):
     env = dict(os.environ)
