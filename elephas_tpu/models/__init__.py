@@ -1,6 +1,6 @@
 from .adapters import KerasModelAdapter
 from .losses import resolve_accuracy, resolve_per_sample_loss
-from .optimizers import to_optax
+from .optimizers import adam_compact, scale_by_adam_compact, to_optax
 from .lora import (
     LoRATensor,
     apply_lora,
@@ -25,6 +25,7 @@ from .transformer import (
     build_lm_train_step,
     build_mesh_sp,
     make_lm_batches,
+    select_tokens,
     shard_lm_batch,
 )
 
@@ -44,7 +45,10 @@ __all__ = [
     "KerasModelAdapter",
     "resolve_per_sample_loss",
     "resolve_accuracy",
+    "adam_compact",
+    "scale_by_adam_compact",
     "to_optax",
+    "select_tokens",
     "SEQ_AXIS",
     "TransformerLM",
     "MoETransformerLM",

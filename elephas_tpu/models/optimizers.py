@@ -13,7 +13,94 @@ from __future__ import annotations
 import warnings
 from typing import Any, Tuple
 
+import jax
+import jax.numpy as jnp
 import optax
+
+
+def scale_by_adam_compact(
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    moment_dtype=jnp.bfloat16,
+) -> optax.GradientTransformation:
+    """Adam moments stored compactly, math in float32.
+
+    On TPU the adam update is pure HBM bandwidth: both moments are read and
+    written every step, so f32 ``m``/``v`` cost 16 bytes/param/step of
+    traffic on top of the gradient and parameter streams (~1.6 GB/step for a
+    100M-param model). Storing the moments in ``moment_dtype`` (bfloat16 by
+    default) halves that and — the bigger lever — halves the optimizer
+    state's resident HBM, which is what bounds model size per chip once
+    activations are rematerialized. All arithmetic (decay, bias correction,
+    the rsqrt) runs in float32; only the *stored* state is compact, so one
+    step's rounding never compounds through the math. bf16's 8 mantissa
+    bits cost ~0.4% relative noise per moment read — measurably loss-neutral
+    (``tests/models/test_optimizers.py`` pins adam-vs-compact convergence).
+
+    State is ``optax.ScaleByAdamState`` (same tree shape as
+    ``optax.scale_by_adam``), so sharding-spec inference
+    (``parallel/param_utils.opt_state_specs``) and checkpointing work
+    unchanged.
+    """
+    moment_dtype = jnp.dtype(moment_dtype)
+
+    def init_fn(params):
+        zeros = lambda p: jnp.zeros(jnp.shape(p), dtype=moment_dtype)
+        return optax.ScaleByAdamState(
+            count=jnp.zeros([], jnp.int32),
+            mu=jax.tree_util.tree_map(zeros, params),
+            nu=jax.tree_util.tree_map(zeros, params),
+        )
+
+    def update_fn(updates, state, params=None):
+        del params
+        count = state.count + 1
+        c = count.astype(jnp.float32)
+        # Bias correction as a scalar rescale of the f32 intermediates.
+        bc1 = 1.0 - jnp.power(jnp.float32(b1), c)
+        bc2 = 1.0 - jnp.power(jnp.float32(b2), c)
+
+        def one(g, m, v):
+            g32 = g.astype(jnp.float32)
+            m32 = b1 * m.astype(jnp.float32) + (1.0 - b1) * g32
+            v32 = b2 * v.astype(jnp.float32) + (1.0 - b2) * g32 * g32
+            u = (m32 / bc1) / (jnp.sqrt(v32 / bc2) + eps)
+            return u, m32.astype(moment_dtype), v32.astype(moment_dtype)
+
+        flat_u, flat_m, flat_v = [], [], []
+        leaves_g, treedef = jax.tree_util.tree_flatten(updates)
+        leaves_m = treedef.flatten_up_to(state.mu)
+        leaves_v = treedef.flatten_up_to(state.nu)
+        for g, m, v in zip(leaves_g, leaves_m, leaves_v):
+            u, m2, v2 = one(g, m, v)
+            flat_u.append(u)
+            flat_m.append(m2)
+            flat_v.append(v2)
+        unflatten = jax.tree_util.tree_unflatten
+        return unflatten(treedef, flat_u), optax.ScaleByAdamState(
+            count=count,
+            mu=unflatten(treedef, flat_m),
+            nu=unflatten(treedef, flat_v),
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def adam_compact(
+    learning_rate: float = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    moment_dtype=jnp.bfloat16,
+) -> optax.GradientTransformation:
+    """:func:`scale_by_adam_compact` chained with the learning-rate scale —
+    a drop-in for ``optax.adam`` with half the optimizer HBM."""
+    return optax.chain(
+        scale_by_adam_compact(b1=b1, b2=b2, eps=eps,
+                              moment_dtype=moment_dtype),
+        optax.scale(-float(learning_rate)),
+    )
 
 
 def _extract_lr(cfg: dict) -> float:
@@ -54,6 +141,16 @@ def to_optax(optimizer_spec: Any) -> optax.GradientTransformation:
         nesterov = bool(cfg.get("nesterov", False))
         return optax.sgd(lr, momentum=momentum or None, nesterov=nesterov)
     if name == "adam":
+        # Config extension beyond Keras: "moment_dtype" selects the compact
+        # (bf16-moment) variant — half the optimizer HBM, f32 math.
+        if cfg.get("moment_dtype"):
+            return adam_compact(
+                lr,
+                b1=float(cfg.get("beta_1", 0.9)),
+                b2=float(cfg.get("beta_2", 0.999)),
+                eps=float(cfg.get("epsilon", 1e-7)),
+                moment_dtype=cfg["moment_dtype"],
+            )
         return optax.adam(
             lr,
             b1=float(cfg.get("beta_1", 0.9)),
