@@ -17,6 +17,7 @@ from .quantize import (
     quantize_lm_params,
     quantized_nbytes,
 )
+from .sharded_generate import build_lm_generate
 from .transformer import (
     SEQ_AXIS,
     MoETransformerLM,
@@ -48,6 +49,7 @@ __all__ = [
     "adam_compact",
     "scale_by_adam_compact",
     "to_optax",
+    "build_lm_generate",
     "select_tokens",
     "SEQ_AXIS",
     "TransformerLM",

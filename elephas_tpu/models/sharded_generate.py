@@ -1,0 +1,274 @@
+"""Sharded LM inference: generate without gathering to one device.
+
+EXTENSION BEYOND THE REFERENCE (whose inference story is ``model.predict``
+on a driver-local replica — SURVEY.md §2.5). A model trained dp×sp
+(``build_lm_train_step``) used to require gathering onto ONE chip to call
+:meth:`TransformerLM.generate`; for the long-context models that axis
+exists to serve, the KV cache is exactly the object that does not fit.
+
+``build_lm_generate`` compiles generation as one ``shard_map`` program over
+the same ``("data", "seq")`` mesh the training step uses:
+
+- **batch** shards over ``"data"`` — each data rank decodes its rows;
+- **the KV cache** shards over ``"seq"`` along the time axis — rank ``r``
+  owns cache positions ``[r·Tl, (r+1)·Tl)``, so per-chip cache memory drops
+  by the seq-axis size; the decode horizon scales with the mesh.
+
+Each decode step, every seq rank attends the query against its local cache
+slice with the lse-exposing flash-decode kernel
+(``ops/flash_decode.flash_decode_lse``) and the partials merge by
+logsumexp — the ring-attention merge applied across the cache:
+
+    lse  = logsumexp_r lse_r            (pmax + psum over "seq")
+    out  = Σ_r exp(lse_r − lse) · out_r (psum over "seq")
+
+Three collectives on ``[B, Hkv, G(, Dh)]`` tensors per layer — tiny
+ICI traffic compared to the cache reads they shard. The new position's K/V
+is written ONLY by its owner rank (non-owners rewrite their current row
+with itself, keeping the update statically shaped); sampling runs
+replicated on every seq rank from identical merged logits, so the ranks
+stay in lockstep without a broadcast.
+
+Prefill runs the full (matrix-matrix) forward per data rank, then each seq
+rank keeps only its slice of the prompt K/V — prompt-length activations
+appear transiently on every rank (same as single-chip prefill), but the
+*standing* cache is sharded. Dense models only: the MoE variant's expert
+stacks shard over "seq" and need the all_to_all decode path (tracked
+limitation).
+
+Exactness: the logsumexp merge is algebraically the same softmax attention
+the single-device path computes, so greedy sharded generation reproduces
+:meth:`TransformerLM.generate` token-for-token
+(``tests/models/test_sharded_generate.py``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.flash_decode import (
+    aligned_cache_length,
+    decode_attention_lse,
+)
+from ..parallel.mesh import DATA_AXIS
+from .transformer import (
+    SEQ_AXIS,
+    TransformerLM,
+    _layer_norm,
+    _rope_angles,
+    _rope_rotate,
+    select_tokens,
+)
+
+
+def _local_cache_len(total: int, sp: int) -> int:
+    """Per-rank cache capacity: the horizon split over ranks, aligned so the
+    flash-decode kernel never pads (a pad would recopy the slice in HBM
+    every step)."""
+    return aligned_cache_length(-(-total // sp))
+
+
+def build_lm_generate(model: TransformerLM, mesh: Mesh,
+                      temperature: float = 0.0,
+                      top_k: Optional[int] = None,
+                      top_p: Optional[float] = None):
+    """Compile sharded generation over ``mesh`` (axes ``"data"``, ``"seq"``).
+
+    Returns ``generate_fn(params, prompt, n_new, seed=0) -> [B, T0+n_new]``
+    with ``prompt [B, T0]`` int; ``B`` must divide by the data-axis size.
+    ``params`` are the (replicated) training-layout params —
+    ``model.shard_params(mesh, ...)`` output works as-is; nothing is
+    gathered. One program is compiled per ``(B, T0, n_new)`` geometry and
+    cached on the returned function.
+    """
+    for name, spec in model.specs().items():
+        if spec != P():
+            raise NotImplementedError(
+                f"sharded generate supports dense (replicated-param) models; "
+                f"param {name!r} has spec {spec} (MoE expert stacks need the "
+                f"all_to_all decode path)"
+            )
+    if DATA_AXIS not in mesh.shape or SEQ_AXIS not in mesh.shape:
+        raise ValueError(
+            f"mesh must carry ({DATA_AXIS!r}, {SEQ_AXIS!r}) axes, got "
+            f"{dict(mesh.shape)}"
+        )
+    if top_k is not None and not 1 <= int(top_k) <= model.vocab:
+        raise ValueError(
+            f"top_k must be in [1, vocab={model.vocab}], got {top_k}"
+        )
+    if top_p is not None and not 0.0 < float(top_p) <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+
+    sp = mesh.shape[SEQ_AXIS]
+    dp = mesh.shape[DATA_AXIS]
+    H = model.n_heads
+    Hkv = model.n_kv_heads
+    Dh = model.d_model // H
+    cd = model.compute_dtype
+    programs: Dict[Any, Any] = {}
+
+    def _merged_decode_attention(qg, kc, vc, pos_local, Tl):
+        """Local flash-decode partial + logsumexp merge over "seq"."""
+        pos_cl = jnp.clip(pos_local, 0, Tl - 1)
+        o_r, lse_r = decode_attention_lse(qg, kc, vc, pos_cl)
+        # A rank whose slice starts past the decode position sees nothing:
+        # its (clamped-pos) partial is valid arithmetic over slot 0, and
+        # zero weight removes it from the merge.
+        lse_r = jnp.where(pos_local >= 0, lse_r, -jnp.inf)
+        m = jax.lax.pmax(lse_r, SEQ_AXIS)
+        w = jnp.exp(lse_r - m)                       # [B, Hkv, G]
+        num = jax.lax.psum(w[..., None] * o_r, SEQ_AXIS)
+        den = jax.lax.psum(w, SEQ_AXIS)
+        return num / den[..., None]                  # [B, Hkv, G, Dh]
+
+    def _decode_step_sharded(params, token, p, kcache, vcache, Tl):
+        """One merged decode step on the local batch/cache shards.
+
+        ``token [B_local]`` at absolute position ``p`` (traced scalar);
+        ``kcache/vcache [L, B_local, Hkv, Tl, Dh]``. Mirrors
+        ``TransformerLM.decode_step`` with the attention and cache write
+        swapped for their sharded forms.
+        """
+        B = token.shape[0]
+        r = jax.lax.axis_index(SEQ_AXIS)
+        pos_local = p - r * Tl
+        is_owner = (pos_local >= 0) & (pos_local < Tl)
+        idx = jnp.clip(pos_local, 0, Tl - 1)
+
+        pos_b = jnp.broadcast_to(p, (B,))
+        h = model._embed(params, token, pos_b)       # [B, D]
+        if model.pos_encoding == "rotary":
+            r_cos, r_sin = _rope_angles(pos_b, Dh)
+            r_cos, r_sin = r_cos[:, None, :], r_sin[:, None, :]
+
+        def block(h, inputs):
+            lp, kc, vc = inputs                      # kc/vc [B, Hkv, Tl, Dh]
+            x = _layer_norm(
+                h.astype(jnp.float32), lp["ln1_s"], lp["ln1_b"]
+            ).astype(cd)
+            q = (x @ lp["wq"].astype(cd)).reshape(B, H, Dh)
+            k_new = (x @ lp["wk"].astype(cd)).reshape(B, Hkv, 1, Dh)
+            v_new = (x @ lp["wv"].astype(cd)).reshape(B, Hkv, 1, Dh)
+            if model.pos_encoding == "rotary":
+                q = _rope_rotate(q, r_cos, r_sin)
+                k_new = _rope_rotate(k_new, r_cos[:, None], r_sin[:, None])
+            # Owner writes the new row; everyone else re-writes its current
+            # row with itself — one [B, Hkv, 1, Dh] gather keeps the update
+            # statically shaped without copying the whole slice through a
+            # select.
+            cur_k = jax.lax.dynamic_slice_in_dim(kc, idx, 1, axis=2)
+            cur_v = jax.lax.dynamic_slice_in_dim(vc, idx, 1, axis=2)
+            kc = jax.lax.dynamic_update_slice_in_dim(
+                kc, jnp.where(is_owner, k_new, cur_k), idx, axis=2)
+            vc = jax.lax.dynamic_update_slice_in_dim(
+                vc, jnp.where(is_owner, v_new, cur_v), idx, axis=2)
+            qg = q.reshape(B, Hkv, H // Hkv, Dh)
+            a = _merged_decode_attention(qg, kc, vc, pos_local, Tl)
+            a = a.astype(cd).reshape(B, H, Dh)
+            h = h + a.reshape(B, model.d_model) @ lp["wo"].astype(cd)
+            x = _layer_norm(
+                h.astype(jnp.float32), lp["ln2_s"], lp["ln2_b"]
+            ).astype(cd)
+            out, _ = model._ffn(lp, x[:, None, :], "dense", SEQ_AXIS,
+                                ep_groups=1)
+            return h + out[:, 0].astype(cd), (kc, vc)
+
+        lps = {k: params[k] for k in model._block_keys()}
+        h, (kc_new, vc_new) = jax.lax.scan(block, h, (lps, kcache, vcache))
+        h = _layer_norm(h.astype(jnp.float32), params["lnf_s"],
+                        params["lnf_b"])
+        return model._logits(params, h), kc_new, vc_new
+
+    def _gen_impl(total: int, Tl: int, params, prompt, key):
+        """The per-rank program: local prompt ``[B_local, T0]``."""
+        B, T0 = prompt.shape
+        r = jax.lax.axis_index(SEQ_AXIS)
+
+        # Prefill the full prompt (matrix-matrix, per data rank), then keep
+        # only this rank's cache slice. The prefill K/V is padded to a
+        # multiple of Tl so every slice start is exact: ranks at or past the
+        # padded length slice garbage that position masking keeps invisible
+        # until a decode write lands there.
+        p_up = -(-T0 // Tl) * Tl
+        tmp = {
+            "k": jnp.zeros((model.n_layers, B, Hkv, p_up, Dh), cd),
+            "v": jnp.zeros((model.n_layers, B, Hkv, p_up, Dh), cd),
+        }
+        logits, tmp = model.prefill(params, prompt, tmp)
+        start = jnp.minimum(r * Tl, p_up - Tl)
+        kcache = jax.lax.dynamic_slice_in_dim(tmp["k"], start, Tl, axis=3)
+        vcache = jax.lax.dynamic_slice_in_dim(tmp["v"], start, Tl, axis=3)
+        # Ranks wholly past the prefilled span must not keep a stale copy of
+        # the last covered slice (its rows would alias real positions): zero
+        # them. Slices are distinct per rank otherwise, so this is the only
+        # aliasing case.
+        past = r * Tl >= p_up
+        kcache = jnp.where(past, jnp.zeros_like(kcache), kcache)
+        vcache = jnp.where(past, jnp.zeros_like(vcache), vcache)
+
+        # Global first row of this data shard: sampling folds the key per
+        # GLOBAL row, so the sharded draw equals the gathered one.
+        row0 = jax.lax.axis_index(DATA_AXIS) * B
+
+        key, k0 = jax.random.split(key)
+        first = select_tokens(logits[:, -1], k0, temperature, top_k, top_p,
+                              row_offset=row0)
+        buf = jnp.zeros((B, total), jnp.int32)
+        buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
+        buf = buf.at[:, T0].set(first)
+
+        def step(carry, t):
+            buf, kcache, vcache, token, key = carry
+            logits, kcache, vcache = _decode_step_sharded(
+                params, token, t, kcache, vcache, Tl
+            )
+            key, kt = jax.random.split(key)
+            nxt = select_tokens(logits, kt, temperature, top_k, top_p,
+                                row_offset=row0)
+            buf = jax.lax.dynamic_update_slice_in_dim(
+                buf, nxt[:, None], t + 1, axis=1
+            )
+            return (buf, kcache, vcache, nxt, key), None
+
+        (buf, _, _, _, _), _ = jax.lax.scan(
+            step, (buf, kcache, vcache, first, key),
+            jnp.arange(T0, total - 1),
+        )
+        return buf
+
+    def generate_fn(params, prompt, n_new: int, seed: int = 0):
+        prompt = jnp.asarray(prompt, jnp.int32)
+        B, T0 = prompt.shape
+        total = T0 + int(n_new)
+        if total > model.max_len:
+            raise ValueError(
+                f"prompt {T0} + n_new {n_new} exceeds max_len "
+                f"{model.max_len}"
+            )
+        if B % dp:
+            raise ValueError(f"batch {B} not divisible by data axis {dp}")
+        if n_new < 1:
+            return prompt
+        Tl = _local_cache_len(total, sp)
+        geom = (B, T0, int(n_new))
+        if geom not in programs:
+            pspecs = {k: P() for k in model.param_shapes()}
+            programs[geom] = jax.jit(
+                jax.shard_map(
+                    functools.partial(_gen_impl, total, Tl),
+                    mesh=mesh,
+                    in_specs=(pspecs, P(DATA_AXIS, None), P()),
+                    out_specs=P(DATA_AXIS, None),
+                    check_vma=False,
+                )
+            )
+        key = jax.random.PRNGKey(seed)
+        return programs[geom](params, prompt, key)
+
+    return generate_fn

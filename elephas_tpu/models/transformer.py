@@ -53,6 +53,39 @@ def build_mesh_sp(data: Optional[int] = None, seq: int = 1, devices=None) -> Mes
     return build_mesh_2axis(SEQ_AXIS, data=data, second=seq, devices=devices)
 
 
+def select_tokens(logits, key, temperature: float = 0.0,
+                  top_k: Optional[int] = None,
+                  top_p: Optional[float] = None, row_offset=0):
+    """The generation sampling rule shared by the plain and sharded decode
+    paths (speculative decoding samples host-side against its acceptance
+    test — see ``generate_speculative``): greedy at ``temperature<=0``;
+    otherwise sample
+    ``softmax(logits/temperature)`` restricted by top-k then nucleus
+    ``top_p`` (the most-probable token always survives). ``logits`` is
+    ``[B, V]``; returns ``[B]`` int32.
+
+    Each row draws from its own key, ``fold_in(key, row_offset + i)`` —
+    NOT from one batched draw — so a batch sharded over a mesh axis
+    samples the identical tokens the gathered batch would
+    (``row_offset`` = the shard's first global row; see
+    models/sharded_generate.py)."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, int(top_k))[0][:, -1:]
+        logits = jnp.where(logits >= kth, logits, -jnp.inf)
+    if top_p is not None and float(top_p) < 1.0:
+        logits = jnp.where(
+            nucleus_mask(logits, float(top_p)), logits, -jnp.inf
+        )
+    rows = row_offset + jnp.arange(logits.shape[0])
+    keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(rows)
+    return jax.vmap(
+        lambda k, l: jax.random.categorical(k, l)
+    )(keys, logits).astype(jnp.int32)
+
+
 def nucleus_mask(logits, top_p: float):
     """Boolean keep-mask of the top-p nucleus, per row of ``[B, V]`` logits.
 
@@ -743,17 +776,7 @@ class TransformerLM:
             return prompt
 
         def select(logits, key):
-            if temperature <= 0.0:
-                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            logits = logits / temperature
-            if top_k is not None:
-                kth = jax.lax.top_k(logits, int(top_k))[0][:, -1:]
-                logits = jnp.where(logits >= kth, logits, -jnp.inf)
-            if top_p is not None and float(top_p) < 1.0:
-                logits = jnp.where(
-                    nucleus_mask(logits, float(top_p)), logits, -jnp.inf
-                )
-            return jax.random.categorical(key, logits).astype(jnp.int32)
+            return select_tokens(logits, key, temperature, top_k, top_p)
 
         key = jax.random.PRNGKey(seed)
         key, k0 = jax.random.split(key)

@@ -56,8 +56,47 @@ def decode_attention_reference(q, k, v, pos):
 
     ``q`` [B, Hkv, G, Dh]; ``k``/``v`` [B, Hkv, T, Dh]; ``pos`` scalar int —
     positions ``0..pos`` (inclusive) are visible. Returns [B, Hkv, G, Dh]
-    float32, softmax in f32.
+    float32, softmax in f32. One body serves this and the lse-exposing
+    variant (same dedup rationale as the Pallas side).
     """
+    return decode_attention_reference_lse(q, k, v, pos)[0]
+
+
+# -- pallas kernel ------------------------------------------------------------
+
+
+def flash_decode(q, k, v, pos, interpret: bool = False):
+    """Fused decode attention (Pallas). Same contract as
+    :func:`decode_attention_reference`; ``pos`` may be a traced scalar.
+
+    One kernel serves both this and :func:`flash_decode_lse` — this entry
+    discards the (tiny, lane-broadcast) lse output rather than keeping a
+    second copy of the online-softmax kernel in sync."""
+    return flash_decode_lse(q, k, v, pos, interpret=interpret)[0]
+
+
+def decode_attention(q, k, v, pos):
+    """Dispatcher: Pallas flash-decode on TPU, jnp reference elsewhere."""
+    if is_tpu_backend():
+        return flash_decode(q, k, v, pos)
+    return decode_attention_reference(q, k, v, pos)
+
+
+# -- lse-exposing variant (sequence-parallel decode) --------------------------
+#
+# When the KV cache is sharded over a mesh axis, each rank attends its local
+# slice and the partials merge by logsumexp — exactly the ring-attention
+# merge (ops/ring_attention.py), applied across the decode cache instead of
+# around a ring:  o = Σ_r exp(lse_r − lse) · o_r,  lse = logsumexp_r lse_r.
+# These variants return that per-rank ``lse`` alongside the normalized
+# output; the cross-rank merge itself lives in models/sharded_generate.py
+# (psum/pmax over the axis — three tiny collectives on [B, Hkv, G] tensors).
+
+
+def decode_attention_reference_lse(q, k, v, pos):
+    """Like :func:`decode_attention_reference` but also returns
+    ``lse [B, Hkv, G] f32`` — the log of the softmax denominator (shifted by
+    nothing: ``logsumexp`` of the masked scaled scores)."""
     dh = q.shape[-1]
     scores = jnp.einsum(
         "bkgd,bktd->bkgt", q, k, preferred_element_type=jnp.float32,
@@ -65,18 +104,19 @@ def decode_attention_reference(q, k, v, pos):
     ) * (dh ** -0.5)
     mask = jnp.arange(k.shape[2])[None, None, None, :] <= pos
     scores = jnp.where(mask, scores, -jnp.inf)
-    probs = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum(
-        "bkgt,bktd->bkgd", probs, v, preferred_element_type=jnp.float32,
+    m = jnp.max(scores, axis=-1)
+    p = jnp.exp(scores - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    out = jnp.einsum(
+        "bkgt,bktd->bkgd", p, v, preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST,
-    )
+    ) / l[..., None]
+    return out, m + jnp.log(l)
 
 
-# -- pallas kernel ------------------------------------------------------------
-
-
-def _decode_kernel(d_true: int, block_t: int, pos_ref, q_ref, k_ref, v_ref,
-                   o_ref, m_s, l_s, acc_s):
+def _decode_kernel_lse(d_true: int, block_t: int, pos_ref, q_ref, k_ref,
+                       v_ref, o_ref, lse_ref, m_s, l_s, acc_s):
+    """:func:`_decode_kernel` plus an lse output (lane-broadcast)."""
     from jax.experimental import pallas as pl
 
     t = pl.program_id(2)
@@ -91,20 +131,20 @@ def _decode_kernel(d_true: int, block_t: int, pos_ref, q_ref, k_ref, v_ref,
 
     @pl.when(start <= pos_ref[0])
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)  # [Gp, Dhp]
-        k = k_ref[0, 0].astype(jnp.float32)  # [BT, Dhp]
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.HIGHEST,
-        ) * (d_true ** -0.5)                 # [Gp, BT]
+        ) * (d_true ** -0.5)
         j = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
         s = jnp.where(j <= pos_ref[0], s, _NEG)
-        m_prev = m_s[:, :1]                  # [Gp, 1]
+        m_prev = m_s[:, :1]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_cur)
-        p = jnp.exp(s - m_cur)               # [Gp, BT]
+        p = jnp.exp(s - m_cur)
         l_s[:] = alpha * l_s[:] + jnp.sum(p, axis=-1, keepdims=True)
         acc_s[:] = alpha * acc_s[:] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
@@ -116,22 +156,18 @@ def _decode_kernel(d_true: int, block_t: int, pos_ref, q_ref, k_ref, v_ref,
     @pl.when(t == pl.num_programs(2) - 1)
     def _finish():
         o_ref[0, 0] = (acc_s[:] / l_s[:, :1]).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_s[:] + jnp.log(l_s[:])
 
 
-def flash_decode(q, k, v, pos, interpret: bool = False):
-    """Fused decode attention (Pallas). Same contract as
-    :func:`decode_attention_reference`; ``pos`` may be a traced scalar."""
+def flash_decode_lse(q, k, v, pos, interpret: bool = False):
+    """Fused decode attention returning ``(out, lse)``; ``pos`` must be
+    ``>= 0`` (a rank with nothing visible clamps pos and overrides its lse
+    to −inf outside the kernel — see models/sharded_generate.py)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     B, Hkv, G, Dh = q.shape
     T = k.shape[2]
-    # Blocks never split G or Dh, so full-dim block shapes are legal at any
-    # size (Mosaic pads tiles in VMEM); only T is blocked and must align.
-    # Padding q is cheap (one query row per sequence); padding K/V is NOT —
-    # it would recopy the whole cache in HBM every decode step — so cache
-    # producers align T up front (generate() rounds the horizon with
-    # :func:`aligned_cache_length`) and the pads below are no-ops then.
     Gp = _pad_up(G, _SUBLANE)
     bt = min(_BLOCK_T, _pad_up(T, _SUBLANE))
     Tp = _pad_up(T, bt)
@@ -146,7 +182,6 @@ def flash_decode(q, k, v, pos, interpret: bool = False):
         grid=(B, Hkv, n_t),
         in_specs=[
             pl.BlockSpec((1, 1, Gp, Dh), lambda b, h, t, s: (b, h, 0, 0)),
-            # blocks past pos are never DMA'd: clamp to the last live block
             pl.BlockSpec(
                 (1, 1, bt, Dh),
                 lambda b, h, t, s: (b, h, jnp.minimum(t, s[0] // bt), 0),
@@ -156,24 +191,30 @@ def flash_decode(q, k, v, pos, interpret: bool = False):
                 lambda b, h, t, s: (b, h, jnp.minimum(t, s[0] // bt), 0),
             ),
         ],
-        out_specs=pl.BlockSpec((1, 1, Gp, Dh), lambda b, h, t, s: (b, h, 0, 0)),
+        out_specs=[
+            pl.BlockSpec((1, 1, Gp, Dh), lambda b, h, t, s: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, Gp, _LANE), lambda b, h, t, s: (b, h, 0, 0)),
+        ],
         scratch_shapes=[
-            pltpu.VMEM((Gp, _LANE), jnp.float32),   # running max (broadcast)
-            pltpu.VMEM((Gp, _LANE), jnp.float32),   # running denominator
-            pltpu.VMEM((Gp, Dh), jnp.float32),      # output accumulator
+            pltpu.VMEM((Gp, _LANE), jnp.float32),
+            pltpu.VMEM((Gp, _LANE), jnp.float32),
+            pltpu.VMEM((Gp, Dh), jnp.float32),
         ],
     )
-    out = pl.pallas_call(
-        functools.partial(_decode_kernel, Dh, bt),
-        out_shape=jax.ShapeDtypeStruct((B, Hkv, Gp, Dh), jnp.float32),
+    out, lse = pl.pallas_call(
+        functools.partial(_decode_kernel_lse, Dh, bt),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hkv, Gp, Dh), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hkv, Gp, _LANE), jnp.float32),
+        ],
         grid_spec=grid_spec,
         interpret=interpret,
     )(pos_arr, qp, kp, vp)
-    return out[:, :, :G, :]
+    return out[:, :, :G, :], lse[:, :, :G, 0]
 
 
-def decode_attention(q, k, v, pos):
-    """Dispatcher: Pallas flash-decode on TPU, jnp reference elsewhere."""
+def decode_attention_lse(q, k, v, pos):
+    """Dispatcher for the lse-exposing decode attention."""
     if is_tpu_backend():
-        return flash_decode(q, k, v, pos)
-    return decode_attention_reference(q, k, v, pos)
+        return flash_decode_lse(q, k, v, pos)
+    return decode_attention_reference_lse(q, k, v, pos)

@@ -93,3 +93,60 @@ def test_large_scores_stable():
     assert np.isfinite(np.asarray(got)).all()
     want = decode_attention_reference(q, k, v, T - 1)
     np.testing.assert_allclose(got, want, atol=1e-4, rtol=1e-4)
+
+
+# -- lse-exposing variant (sequence-parallel decode merge) -------------------
+
+
+def test_lse_matches_reference_lse():
+    """flash_decode_lse's (out, lse) vs the reference pair, across GQA
+    shapes, multi-block caches, and block-boundary positions."""
+    from elephas_tpu.ops.flash_decode import (
+        decode_attention_reference_lse,
+        flash_decode_lse,
+    )
+
+    rng = np.random.default_rng(5)
+    for (hkv, g, dh, t) in [(2, 2, 16, 40), (1, 4, 32, 300), (2, 5, 16, 257)]:
+        q = rand(rng, 2, hkv, g, dh)
+        k = rand(rng, 2, hkv, t, dh)
+        v = rand(rng, 2, hkv, t, dh)
+        for pos in (0, t // 2, t - 1):
+            got_o, got_lse = flash_decode_lse(q, k, v, pos, interpret=True)
+            want_o, want_lse = decode_attention_reference_lse(q, k, v, pos)
+            np.testing.assert_allclose(got_o, want_o, atol=1e-5, rtol=1e-5,
+                                       err_msg=f"out pos={pos}")
+            np.testing.assert_allclose(got_lse, want_lse, atol=1e-5,
+                                       rtol=1e-5, err_msg=f"lse pos={pos}")
+
+
+def test_lse_merge_reconstructs_full_attention():
+    """The logsumexp partial merge (the sharded-decode contract): splitting
+    the cache into R slices, attending each with its own lse, and merging
+    must equal attention over the whole cache."""
+    from elephas_tpu.ops.flash_decode import (
+        decode_attention_reference,
+        flash_decode_lse,
+    )
+
+    rng = np.random.default_rng(6)
+    B, Hkv, G, Dh, T, R = 2, 2, 2, 16, 64, 4
+    Tl = T // R
+    q = rand(rng, B, Hkv, G, Dh)
+    k = rand(rng, B, Hkv, T, Dh)
+    v = rand(rng, B, Hkv, T, Dh)
+    for pos in (0, 13, Tl - 1, Tl, T - 1):
+        outs, lses = [], []
+        for r in range(R):
+            pos_local = pos - r * Tl
+            o_r, lse_r = flash_decode_lse(
+                q, k[:, :, r * Tl:(r + 1) * Tl], v[:, :, r * Tl:(r + 1) * Tl],
+                max(0, min(pos_local, Tl - 1)), interpret=True)
+            lses.append(np.where(pos_local >= 0, np.asarray(lse_r), -np.inf))
+            outs.append(np.asarray(o_r))
+        m = np.max(lses, axis=0)
+        w = np.exp(np.asarray(lses) - m)                      # [R, B, Hkv, G]
+        merged = (w[..., None] * np.asarray(outs)).sum(0) / w.sum(0)[..., None]
+        want = decode_attention_reference(q, k, v, pos)
+        np.testing.assert_allclose(merged, want, atol=1e-5, rtol=1e-5,
+                                   err_msg=f"pos={pos}")
