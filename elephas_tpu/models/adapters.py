@@ -169,11 +169,20 @@ class KerasModelAdapter:
         return flat
 
     def install_state(self, tv: Sequence, ntv: Sequence) -> None:
-        """Assign ``(tv, ntv)`` back into the live Keras variables."""
+        """Assign ``(tv, ntv)`` back into the live Keras variables.
+
+        Values are assigned as-is: a compiled fit's device-resident outputs
+        stay on device (the Keras-JAX backend holds variable values as jax
+        arrays), so installing trained state costs no host round-trip —
+        measured at ~50 s per ResNet-50 fit on a relay-attached chip
+        (~100 MB of weights each way at ~4 MB/s), and a wasted double copy
+        even on a directly-attached host. ``get_weights()`` still
+        materializes to numpy on demand.
+        """
         for var, value in zip(self.model.trainable_variables, tv):
-            var.assign(np.asarray(value))
+            var.assign(value)
         for var, value in zip(self.model.non_trainable_variables, ntv):
-            var.assign(np.asarray(value))
+            var.assign(value)
 
     # -- compiled-step builders ------------------------------------------
     def _require_loss(self):

@@ -160,16 +160,27 @@ def _psum_val_metrics(vstats):
 
 
 class FitResult:
-    """Final weights + Keras-``History``-shaped metrics (+ carryable state)."""
+    """Final weights + Keras-``History``-shaped metrics (+ carryable state).
 
-    def __init__(self, weights: List[np.ndarray], history: Dict[str, List[float]],
+    ``weights`` materializes lazily: host numpy copies are only pulled when
+    the attribute is read (the checkpoint path), so ordinary fits never pay
+    the device→host weight transfer.
+    """
+
+    def __init__(self, weights, history: Dict[str, List[float]],
                  opt_state: Any = None, timings: Optional[Dict[str, float]] = None,
                  worker_state: Any = None):
-        self.weights = weights
+        self._weights = weights  # list OR zero-arg thunk
         self.history = history
         self.opt_state = opt_state
         self.timings = timings or {}
         self.worker_state = worker_state
+
+    @property
+    def weights(self) -> List[np.ndarray]:
+        if callable(self._weights):
+            self._weights = self._weights()
+        return self._weights
 
 
 class CompiledTrainer:
@@ -367,13 +378,21 @@ class CompiledTrainer:
         jax.block_until_ready(tv_out)
         t_run = time.perf_counter() - t_start
 
-        # -- install merged state back into the live model
-        tv_out = [np.asarray(t) for t in tv_out]
+        # -- install merged state back into the live model, ON DEVICE: the
+        # Keras-JAX variables accept the compiled program's outputs directly,
+        # so trained weights never round-trip the host (at relay/PCIe
+        # bandwidth that round trip dominates large-model fits; see
+        # install_state). Host copies materialize lazily via result.weights.
         ntv_full = []
         ntv_out = list(ntv_out)
         for is_m, cur in zip(mergeable, ntv0):
-            ntv_full.append(np.asarray(ntv_out.pop(0)) if is_m else np.asarray(cur))
-        self.adapter.install_state(tv_out, ntv_full)
+            ntv_full.append(ntv_out.pop(0) if is_m else cur)
+        self.adapter.install_state(list(tv_out), ntv_full)
+        # Snapshot THIS fit's outputs (device handles are immutable, unlike
+        # the live variables a later fit would overwrite); numpy materializes
+        # only if result.weights is actually read.
+        flat_dev = self.adapter.state_to_weights(list(tv_out), ntv_full)
+        weights_thunk = lambda: [np.asarray(w) for w in flat_dev]  # noqa: E731
 
         history: Dict[str, List[float]] = {"loss": [float(v) for v in metrics["loss"]]}
         if self.adapter.wants_accuracy:
@@ -389,7 +408,7 @@ class CompiledTrainer:
                     line += f" - val_loss: {history['val_loss'][e]:.4f}"
                 print(line)
         return FitResult(
-            self.adapter.get_weights(), history,
+            weights_thunk, history,
             opt_state=opt_state_out if keep_opt_state else None,
             timings={"run_seconds": t_run,
                      "samples_per_sec": sum(n_trains) * E / max(t_run, 1e-9)},
