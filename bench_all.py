@@ -44,7 +44,17 @@ def _accuracy(model_like, x, y):
 
 
 def config2_mnist_cnn():
-    """Sync vs async vs hogwild CNN: samples/sec/chip + accuracy envelope."""
+    """Sync vs async vs hogwild CNN: samples/sec/chip + accuracy envelope.
+
+    Async/hogwild each measure BOTH schedules: ``compiled``
+    (``parameter_server_mode='jax'`` — the TPU-first path, whole run in one
+    XLA program with documented one-period staleness) and ``host`` (live
+    parameter server through HTTP, the reference's semantics). The envelope
+    is only meaningful off the accuracy ceiling, so the default geometry is
+    ONE epoch (BENCH_ALL_C2_EPOCHS to override) — at 3 epochs every mode
+    used to hit test accuracy 1.000 and the measured envelope was vacuously
+    0.000.
+    """
     import jax
     import numpy as np
 
@@ -56,7 +66,8 @@ def config2_mnist_cnn():
     from mnist_cnn_async import make_cnn
 
     n = int(os.environ.get("BENCH_ALL_SAMPLES", 8192))
-    epochs = int(os.environ.get("BENCH_ALL_EPOCHS", 3))
+    epochs = int(os.environ.get(
+        "BENCH_ALL_C2_EPOCHS", os.environ.get("BENCH_ALL_EPOCHS", 1)))
     n_dev = jax.local_device_count()
     n_workers = max(n_dev, 2)
 
@@ -64,31 +75,41 @@ def config2_mnist_cnn():
     sc = SparkContext(master=f"local[{n_workers}]", appName="bench_all_c2")
     rdd = to_simple_rdd(sc, x_tr, y_tr, num_slices=n_workers)
 
+    cells = (
+        ("sync", "synchronous", "jax"),
+        ("async_compiled", "asynchronous", "jax"),
+        ("async_host", "asynchronous", "http"),
+        ("hogwild_compiled", "hogwild", "jax"),
+        ("hogwild_host", "hogwild", "http"),
+    )
     out = {}
-    for mode in ("synchronous", "asynchronous", "hogwild"):
+    for name, mode, ps_mode in cells:
         sm = SparkModel(make_cnn(), mode=mode, frequency="epoch",
-                        num_workers=n_workers, merge="mean")
+                        num_workers=n_workers, merge="mean",
+                        parameter_server_mode=ps_mode)
         sm.fit(rdd, epochs=epochs, batch_size=64, verbose=0,
                validation_split=0.0)  # warmup: compile at this geometry
+        acc = _accuracy(sm, x_te, y_te)  # accuracy after the FIRST fit:
+        # the envelope compares one pass from identical fresh weights
         t0 = time.perf_counter()
         sm.fit(rdd, epochs=epochs, batch_size=64, verbose=0,
                validation_split=0.0)
         dt = time.perf_counter() - t0
         sps_chip = n * epochs / dt / n_dev
-        acc = _accuracy(sm, x_te, y_te)
-        out[mode] = {
+        out[name] = {
             "samples_per_sec_per_chip": round(sps_chip, 1),
             "test_accuracy": round(acc, 4),
         }
-        log(f"config2 {mode}: {sps_chip:,.0f} samples/sec/chip, "
-            f"acc {acc:.4f}")
+        log(f"config2 {name} ({mode}/{ps_mode}): {sps_chip:,.0f} "
+            f"samples/sec/chip steady-state, first-fit acc {acc:.4f}")
     sc.stop()
-    # convergence envelope: async/hogwild accuracy relative to sync
-    sync_acc = out["synchronous"]["test_accuracy"]
-    for m in ("asynchronous", "hogwild"):
-        out[m]["accuracy_vs_sync"] = round(
-            out[m]["test_accuracy"] - sync_acc, 4
-        )
+    # convergence envelope: each cell's first-fit accuracy relative to sync
+    sync_acc = out["sync"]["test_accuracy"]
+    for name in out:
+        if name != "sync":
+            out[name]["accuracy_vs_sync"] = round(
+                out[name]["test_accuracy"] - sync_acc, 4
+            )
     return out
 
 
@@ -243,6 +264,274 @@ def config5_hyperparam():
     }
 
 
+def conv_train_flops_per_sample(model) -> float:
+    """Analytic training FLOPs per sample for a Keras conv net — matmul/conv
+    FLOPs only (the MFU convention, same rigor as ``bench.py``'s
+    ``lm_train_flops_per_token``): a Conv2D costs ``2·kh·kw·cin·cout·Ho·Wo``
+    forward (each output pixel is a ``kh·kw·cin``-deep dot), a Dense
+    ``2·cin·cout``; training ≈ 3x forward (backward is two conv-sized
+    contractions). BN/ReLU/pool are bandwidth, not FLOPs, and are excluded.
+    """
+    import keras
+
+    fwd = 0.0
+    for layer in model.layers:
+        if isinstance(layer, keras.layers.Conv2D):
+            kh, kw = layer.kernel_size
+            cin = int(layer.input.shape[-1])
+            _, ho, wo, cout = layer.output.shape
+            fwd += 2.0 * kh * kw * cin * cout * ho * wo
+        elif isinstance(layer, keras.layers.Dense):
+            fwd += 2.0 * int(layer.input.shape[-1]) * int(layer.units)
+    return 3.0 * fwd
+
+
+def config6_conv_mfu():
+    """FLOPs-accounted ResNet-50 training throughput + MFU, remat on/off.
+
+    The LM benchmark carries the chip's efficiency story; this config gives
+    conv workloads the same rigor: analytic conv FLOPs (above), steady-state
+    samples/sec through the compiled engine, MFU against the spec-sheet
+    peak, and the cost of rematerialization (recompute-in-backward) on the
+    identical geometry. Gated to TPU by default (BENCH_ALL_CONV=1 forces —
+    an MFU against a CPU has no meaning). Input size via
+    BENCH_ALL_CONV_IMAGE (default 64: CIFAR-class images keep the relay
+    compile tractable; the per-sample FLOPs accounting makes the number
+    comparable across sizes).
+    """
+    import jax
+    import keras
+    import numpy as np
+
+    from elephas_tpu import SparkModel
+    from elephas_tpu.data import SparkContext
+    from elephas_tpu.utils import to_simple_rdd
+
+    gate = os.environ.get("BENCH_ALL_CONV", "auto")
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if gate == "0" or (gate == "auto" and not on_tpu):
+        log("config6 conv: skipped (not on TPU; BENCH_ALL_CONV=1 forces)")
+        return {"skipped": "not on TPU"}
+
+    from bench import peak_bf16_flops
+
+    img = int(os.environ.get("BENCH_ALL_CONV_IMAGE", 64))
+    n = int(os.environ.get("BENCH_ALL_CONV_SAMPLES", 2048))
+    batch = int(os.environ.get("BENCH_ALL_CONV_BATCH", 64))
+    n_dev = jax.local_device_count()
+
+    rng = np.random.default_rng(0)
+    x = rng.uniform(0, 1, size=(n, img, img, 3)).astype("float32")
+    y = np.eye(10, dtype="float32")[rng.integers(0, 10, size=n)]
+    sc = SparkContext(master=f"local[{n_dev}]", appName="bench_all_c6")
+    rdd = to_simple_rdd(sc, x, y, num_slices=n_dev)
+
+    def make_resnet():
+        model = keras.applications.ResNet50(
+            weights=None, input_shape=(img, img, 3), classes=10)
+        model.compile(optimizer="sgd", loss="categorical_crossentropy")
+        return model
+
+    flops_sample = conv_train_flops_per_sample(make_resnet())
+    peak = peak_bf16_flops(jax.devices()[0])
+    out = {"flops_per_sample": round(flops_sample),
+           "image": img, "batch": batch}
+
+    # A fit's wall-clock on a relay-attached chip is dominated by the
+    # per-fit weight round-trip (the ~100 MB ResNet-50 state moves at
+    # ~4 MB/s through this tunnel — measured; a directly-attached host
+    # moves it in tens of ms). So two figures are reported: raw
+    # steady-state samples/sec (environment-honest), and the MARGINAL
+    # per-step cost from differencing a 1-epoch and a 3-epoch fit — the
+    # fixed per-fit transfer cancels, leaving the compiled program's
+    # actual per-step time, which is what MFU is computed from.
+    e_lo, e_hi = 1, 3
+
+    def best_fit_time(sm, epochs, reps=2):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            sm.fit(rdd, epochs=epochs, batch_size=batch, verbose=0,
+                   validation_split=0.0)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    # Match the engine's actual schedule: S = ceil(per-worker samples / B)
+    # (engine.py pads the last batch), and never 0 — a huge BENCH_ALL_CONV
+    # batch must not zero-divide the marginal-step math.
+    steps_per_epoch = max(1, -(-(n // n_dev) // batch))
+    for name, remat in (("remat_off", False), ("remat_on", True)):
+        sm = SparkModel(make_resnet(), mode="synchronous", num_workers=n_dev,
+                        remat=remat)
+        sm.fit(rdd, epochs=e_lo, batch_size=batch, verbose=0,
+               validation_split=0.0)  # warmup/compile @ e_lo
+        t_lo = best_fit_time(sm, e_lo)
+        sm.fit(rdd, epochs=e_hi, batch_size=batch, verbose=0,
+               validation_split=0.0)  # warmup/compile @ e_hi
+        t_hi = best_fit_time(sm, e_hi)
+        sps_raw = n * e_lo / t_lo / n_dev
+        step_ms = max(t_hi - t_lo, 1e-9) / ((e_hi - e_lo) * steps_per_epoch)
+        sps_marginal = batch / step_ms
+        cell = {
+            "samples_per_sec_per_chip_raw": round(sps_raw, 1),
+            "marginal_step_ms": round(step_ms * 1e3, 1),
+            "samples_per_sec_per_chip_marginal": round(sps_marginal, 1),
+        }
+        if peak:
+            cell["mfu_marginal"] = round(
+                flops_sample * sps_marginal / peak, 4)
+        out[name] = cell
+        log(f"config6 resnet50@{img} {name}: raw {sps_raw:,.0f} sps/chip; "
+            f"marginal {step_ms * 1e3:.0f} ms/step = {sps_marginal:,.0f} "
+            f"sps/chip, {flops_sample * sps_marginal / 1e12:.1f} TFLOP/s"
+            + (f", MFU {cell['mfu_marginal'] * 100:.1f}%" if peak else ""))
+    sc.stop()
+    return out
+
+
+def config7_speculative():
+    """Speculative decoding measured on a trained draft/target pair.
+
+    Random-weight models never agree, so acceptance is meaningless there;
+    this config trains BOTH models on the same synthetic Markov language
+    (next token = deterministic map of the current with prob q, else
+    uniform noise — learnable in a few hundred steps) and then measures,
+    for greedy decoding of held-out prompts:
+
+    - ``acceptance_rate``: accepted draft proposals / proposed;
+    - ``seq_pass_reduction``: n_new / verify rounds — the ALGORITHMIC win
+      (sequential target passes saved), dispatch-environment-independent;
+    - measured wall tokens/sec for plain cached decode vs speculative.
+
+    On this rig the relay imposes a per-dispatch floor and the speculative
+    loop is host-driven (spec_k+1 dispatches per round vs ONE compiled
+    scan for plain decode), so the WALL ratio here understates on-chip
+    speedup — seq_pass_reduction and acceptance are the portable numbers
+    (same caveat discipline as docs/PERFORMANCE.md's flash-decode entry).
+    TPU-gated (BENCH_ALL_SPEC=1 forces).
+    """
+    import jax
+    import numpy as np
+    import optax
+
+    gate = os.environ.get("BENCH_ALL_SPEC", "auto")
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if gate == "0" or (gate == "auto" and not on_tpu):
+        log("config7 speculative: skipped (not on TPU; BENCH_ALL_SPEC=1 "
+            "forces)")
+        return {"skipped": "not on TPU"}
+
+    from elephas_tpu.models import (
+        TransformerLM, build_lm_train_step, build_mesh_sp, make_lm_batches,
+        shard_lm_batch,
+    )
+
+    V, T, q = 256, 128, 0.9
+    steps = int(os.environ.get("BENCH_ALL_SPEC_STEPS", 150))
+    n_new = int(os.environ.get("BENCH_ALL_SPEC_NEW", 128))
+    spec_k = int(os.environ.get("BENCH_ALL_SPEC_K", 4))
+    rng = np.random.default_rng(0)
+
+    def chain(b, t, seed):
+        r = np.random.default_rng(seed)
+        rows = np.empty((b, t), np.int64)
+        rows[:, 0] = r.integers(0, V, size=b)
+        nxt = (np.arange(V) * 7 + 13) % V  # the deterministic successor map
+        for j in range(1, t):
+            noise = r.integers(0, V, size=b)
+            take = r.random(b) < q
+            rows[:, j] = np.where(take, nxt[rows[:, j - 1]], noise)
+        return rows
+
+    mesh = build_mesh_sp(data=1, seq=1)
+
+    def train(model, seed, n_steps):
+        step, opt_init = build_lm_train_step(
+            model, mesh, optax.adam(3e-3), attn="flash")
+        params = model.shard_params(mesh, model.init(seed=seed))
+        state = opt_init(params)
+        loss = None
+        for i in range(n_steps):
+            rows = chain(16, T + 1, seed=1000 + i)
+            batch = shard_lm_batch(mesh, *make_lm_batches(rows))
+            params, state, loss = step(params, state, *batch)
+        log(f"config7: trained {n_steps} steps "
+            f"(final loss {float(loss):.3f})")
+        return params
+
+    horizon = 32 + n_new + spec_k + 2
+    target = TransformerLM(vocab=V, d_model=512, n_heads=4, n_layers=4,
+                           d_ff=2048, max_len=max(T, horizon),
+                           compute_dtype="bfloat16", pos_encoding="rotary")
+    draftm = TransformerLM(vocab=V, d_model=128, n_heads=1, n_layers=2,
+                           d_ff=512, max_len=max(T, horizon),
+                           compute_dtype="bfloat16", pos_encoding="rotary")
+    # The draft trains on a THIRD of the steps: a fully-converged draft on
+    # this near-deterministic language accepts ~100% (both models argmax
+    # the successor map), which demonstrates the mechanism but never
+    # exercises rejection — an undertrained draft gives an acceptance rate
+    # that actually discriminates.
+    t_params = train(target, 0, steps)
+    d_params = train(draftm, 1, max(steps // 3, 1))
+
+    prompt = chain(1, 32, seed=99).astype(np.int32)
+
+    # plain cached decode (one compiled scan) — warmup then best-of-2
+    plain = None
+    t_plain = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        plain = np.asarray(target.generate(t_params, prompt, n_new))
+        dt = time.perf_counter() - t0
+        t_plain = min(t_plain, dt)  # first rep absorbs compile
+    # speculative — same schedule
+    stats = None
+    t_spec = float("inf")
+    spec = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        spec, stats = target.generate_speculative(
+            t_params, prompt, n_new, draftm, d_params, spec_k=spec_k,
+            with_stats=True)
+        dt = time.perf_counter() - t0
+        t_spec = min(t_spec, dt)
+    spec = np.asarray(spec)
+    agree = bool((spec == plain).all())  # greedy: must match the target
+
+    # Sampled cell: greedy acceptance is STRUCTURALLY ~1.0 on this language
+    # (both models argmax the same learned successor map), so the rejection
+    # rule never fires; at temperature the acceptance rate is the measured
+    # draft/target distribution overlap — the discriminating number.
+    _, s_stats = target.generate_speculative(
+        t_params, prompt, n_new, draftm, d_params, spec_k=spec_k,
+        temperature=0.8, with_stats=True)
+
+    out = {
+        "acceptance_rate_greedy": round(stats["acceptance_rate"], 4),
+        "acceptance_rate_sampled_t0.8": round(
+            s_stats["acceptance_rate"], 4),
+        "rounds": stats["rounds"],
+        "n_new": n_new,
+        "seq_pass_reduction": round(n_new / stats["rounds"], 2),
+        "seq_pass_reduction_sampled": round(
+            n_new / s_stats["rounds"], 2),
+        "spec_k": spec_k,
+        "plain_tokens_per_sec": round(n_new / t_plain, 1),
+        "spec_tokens_per_sec": round(n_new / t_spec, 1),
+        "wall_speedup": round(t_plain / t_spec, 3),
+        "greedy_output_matches_target": agree,
+    }
+    log(f"config7: acceptance {out['acceptance_rate_greedy']:.2%} greedy / "
+        f"{out['acceptance_rate_sampled_t0.8']:.2%} sampled, "
+        f"{stats['rounds']} verify rounds for {n_new} tokens "
+        f"({out['seq_pass_reduction']}x fewer sequential target passes; "
+        f"{out['seq_pass_reduction_sampled']}x sampled), "
+        f"wall {out['plain_tokens_per_sec']:.0f} -> "
+        f"{out['spec_tokens_per_sec']:.0f} tok/s "
+        f"(x{out['wall_speedup']}), match={agree}")
+    return out
+
+
 def main():
     from harness_env import cpu_mesh_env, probe_backend
 
@@ -261,6 +550,8 @@ def main():
         ("imdb_lstm_pipeline", config3_imdb_lstm),
         ("mllib", config4_mllib),
         ("hyperparam_search", config5_hyperparam),
+        ("conv_mfu", config6_conv_mfu),
+        ("speculative", config7_speculative),
     ):
         try:
             results[name] = fn()

@@ -589,7 +589,7 @@ class TransformerLM:
     def generate_speculative(self, params, prompt, n_new: int,
                              draft: "TransformerLM", draft_params,
                              spec_k: int = 4, temperature: float = 0.0,
-                             seed: int = 0):
+                             seed: int = 0, with_stats: bool = False):
         """Speculative decoding (Leviathan/Chen et al.): a small ``draft``
         model proposes ``spec_k`` tokens per round with cheap cached decode
         steps; the target model scores all of them in ONE
@@ -611,7 +611,11 @@ class TransformerLM:
         target's vocabulary; proposals use plain temperature sampling
         (no top-k/top-p). Latency-oriented: fewer sequential target steps
         per emitted token at the cost of draft work — the win grows with
-        the target/draft size ratio.
+        the target/draft size ratio. ``with_stats=True`` additionally
+        returns ``{rounds, proposed, accepted, acceptance_rate,
+        tokens_emitted}`` — ``rounds`` is the number of sequential target
+        passes, vs ``n_new`` for plain cached decode (the measured
+        algorithmic win; ``bench_all.py`` config 7).
 
         Exactness caveat: "equals greedy generate" is bit-for-bit where the
         verify and rollout paths share attention numerics (the CPU/einsum
@@ -678,8 +682,10 @@ class TransformerLM:
         carry = choose(t_logits[0, -1])
         out.append(carry)
         pos = T0  # absolute position of `carry`, not yet in either cache
+        rounds = proposed = accepted = 0
 
         while len(out) < total:
+            rounds += 1
             # -- draft spec_k proposals (cheap sequential steps) ----------
             d_toks, d_probs = [], []
             tok, p = carry, pos
@@ -735,11 +741,25 @@ class TransformerLM:
                 _, d_cache = draft_step(draft_params,
                                         jnp.asarray([d_toks[-1]], jnp.int32),
                                         pos + spec_k, d_cache)
+            proposed += spec_k
+            accepted += n
             out.extend(emitted)
             pos += len(emitted)
             carry = emitted[-1]
 
-        return jnp.asarray([out[:total]], jnp.int32)
+        tokens = jnp.asarray([out[:total]], jnp.int32)
+        if with_stats:
+            # rounds = sequential target (verify) passes; plain cached
+            # decode would need n_new sequential target steps — the ratio
+            # is the algorithmic win, independent of dispatch overheads.
+            return tokens, {
+                "rounds": rounds,
+                "proposed": proposed,
+                "accepted": accepted,
+                "acceptance_rate": accepted / max(proposed, 1),
+                "tokens_emitted": int(total - T0),
+            }
+        return tokens
 
     def generate(self, params, prompt, n_new: int,
                  temperature: float = 0.0, top_k: Optional[int] = None,

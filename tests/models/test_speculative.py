@@ -187,3 +187,24 @@ def test_speculative_validation():
         target.generate_speculative(t_params, np.zeros((1, 6), np.int32),
                                     n_new=4, draft=draft,
                                     draft_params=d_params)
+
+
+def test_with_stats_contract():
+    """with_stats returns the same tokens plus internally consistent
+    accounting: accepted <= proposed = rounds*spec_k, and every round
+    emits between 1 and spec_k+1 tokens."""
+    target, draft = _model(), _model(d_model=8, n_heads=2, d_ff=16)
+    tp, dp = _params(target, 3), _params(draft, 4)
+    prompt = np.asarray([[1, 2, 3, 4]], np.int32)
+    plain = np.asarray(target.generate_speculative(
+        tp, prompt, 14, draft, dp, spec_k=3))
+    toks, stats = target.generate_speculative(
+        tp, prompt, 14, draft, dp, spec_k=3, with_stats=True)
+    np.testing.assert_array_equal(np.asarray(toks), plain)
+    assert stats["tokens_emitted"] == 14
+    assert stats["proposed"] == stats["rounds"] * 3
+    assert 0 <= stats["accepted"] <= stats["proposed"]
+    assert stats["acceptance_rate"] == stats["accepted"] / stats["proposed"]
+    # every round emits >= 1 token (first token comes from the prefill)
+    assert stats["rounds"] >= (14 - 1) // (3 + 1)
+    assert stats["rounds"] <= 14
