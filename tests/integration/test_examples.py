@@ -25,6 +25,7 @@ EXAMPLES = [
     "transformer_lm.py",
     "parallelism_tour.py",
     "lm_inference_tour.py",
+    "sharded_generate.py",
     "resnet50_spark.py",
     "ml_pipeline_notebook.ipynb",  # executed via nbconvert
 ]

@@ -191,3 +191,39 @@ def test_native_topk_residual_flush_on_close_and_commit():
                 assert server.attempt_count() == 0
         finally:
             server.stop()
+
+
+@pytest.mark.parametrize("dtype", ["bfloat16", "float16"])
+def test_low_precision_weights_round_trip(dtype):
+    """bf16/f16 weights ride the f32 store losslessly: get_parameters
+    restores the original dtype, and pushed deltas (cast through f32 on the
+    wire) apply exactly — the dtype-parity contract for the native stack
+    (values are exactly representable, so no tolerance is needed)."""
+    import ml_dtypes  # registers bfloat16 with numpy
+
+    dt = np.dtype("float16") if dtype == "float16" else ml_dtypes.bfloat16
+    weights = [np.ones((8, 4), dt), (np.arange(6) / 4).astype(dt)]
+    server = NativeServer(weights, mode="asynchronous", port=0)
+    server.start()
+    try:
+        client = NativeClient([w.shape for w in weights],
+                              [w.dtype for w in weights], server.port)
+        got = client.get_parameters()
+        assert got[0].dtype == weights[0].dtype
+        np.testing.assert_array_equal(
+            got[1].astype("float32"), weights[1].astype("float32"))
+        delta = [np.full((8, 4), 0.5, dt), np.full((6,), 0.25, dt)]
+        client.update_parameters(delta)
+        got2 = client.get_parameters()
+        assert got2[0].dtype == weights[0].dtype
+        np.testing.assert_array_equal(got2[0].astype("float32"),
+                                      np.full((8, 4), 0.5, "float32"))
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_f64_rejected_loudly():
+    with pytest.raises(ValueError, match="truncated"):
+        NativeServer([np.zeros((3,), "float64")], mode="asynchronous",
+                     port=0)
