@@ -18,6 +18,13 @@ from .quantize import (
     quantized_nbytes,
 )
 from .sharded_generate import build_lm_generate
+from .tensor_lm import (
+    build_lm_tp_generate,
+    build_lm_tp_train_step,
+    build_mesh_tp,
+    shard_tp_params,
+    tp_specs,
+)
 from .transformer import (
     SEQ_AXIS,
     MoETransformerLM,
@@ -50,6 +57,11 @@ __all__ = [
     "scale_by_adam_compact",
     "to_optax",
     "build_lm_generate",
+    "build_lm_tp_generate",
+    "build_lm_tp_train_step",
+    "build_mesh_tp",
+    "shard_tp_params",
+    "tp_specs",
     "select_tokens",
     "SEQ_AXIS",
     "TransformerLM",
