@@ -128,11 +128,12 @@ def bench_lm(reps: int, overrides: dict | None = None):
 
     d_model = int(knob("dmodel", 2048))
     n_layers = int(knob("layers", 8))
-    # 8 heads: Dh >= 128 keeps the attention dots' contraction MXU-deep
-    # (Dh=64 heads measured at roughly half occupancy: H16/Dh64 28.6% MFU
-    # vs H8/Dh128 38.1% at d1024), and at d2048 the Dh=256 variant measures
-    # ~1 MFU point above Dh=128 (55.8% vs 54.8% — fewer, deeper heads).
-    n_heads = int(knob("heads", 8))
+    # Dh >= 128 keeps the attention dots' contraction MXU-deep (Dh=64
+    # heads measured at roughly half occupancy: H16/Dh64 28.6% MFU vs
+    # H8/Dh128 38.1% at d1024), and at d2048 the Dh=256 variant measures
+    # ~1 MFU point above Dh=128 (55.8% vs 54.8% — fewer, deeper heads):
+    # cap at 8 heads but never let a small d_model push Dh below 128.
+    n_heads = int(knob("heads", max(1, min(8, d_model // 128))))
     d_ff = int(knob("dff", 4 * d_model))
     vocab = int(knob("vocab", 8192))
     n_kv = knob("kv_heads", None)  # GQA: fewer KV heads

@@ -127,6 +127,62 @@ def _layer_norm(x, scale, bias, eps: float = 1e-5):
     return layer_norm(x, scale, bias, eps)
 
 
+def _spec_probs(logits_row, temperature: float):
+    """Host-side softmax in f64 (speculative decoding's acceptance math)."""
+    x = np.asarray(logits_row, np.float64) / temperature
+    x -= x.max()
+    e = np.exp(x)
+    return e / e.sum()
+
+
+def _spec_accept_row(vl_row, d_toks_row, d_probs_row, spec_k: int,
+                     vocab: int, temperature: float, rng):
+    """One row's speculative acceptance → ``(emitted tokens, n accepted)``.
+
+    ``vl_row [spec_k+1, V]`` target logits over the chunk; greedy accepts
+    while the target argmax agrees, sampled mode applies the
+    distribution-preserving rejection rule (accept draft ``d`` w.p.
+    ``min(1, p_t(d)/p_d(d))``, resample rejections from ``(p_t − p_d)+``,
+    bonus from ``p_t``). Shared by the batch-1 and batched loops so the
+    rule can never drift between them.
+    """
+    if temperature <= 0.0:
+        t_arg = vl_row.argmax(axis=-1)
+        n = 0
+        while n < spec_k and int(t_arg[n]) == int(d_toks_row[n]):
+            n += 1
+        return [int(x) for x in d_toks_row[:n]] + [int(t_arg[n])], n
+    n = 0
+    for i in range(spec_k):
+        pt = _spec_probs(vl_row[i], temperature)
+        pd = d_probs_row[i]
+        d = int(d_toks_row[i])
+        if rng.random() < min(1.0, pt[d] / max(pd[d], 1e-20)):
+            n += 1
+            continue
+        resid = np.maximum(pt - pd, 0.0)
+        z = resid.sum()
+        resid = resid / z if z > 0 else pt
+        return ([int(x) for x in d_toks_row[:n]]
+                + [int(rng.choice(vocab, p=resid))], n)
+    return ([int(x) for x in d_toks_row]
+            + [int(rng.choice(vocab,
+                              p=_spec_probs(vl_row[spec_k], temperature)))],
+            n)
+
+
+def _cache_update_rows(cache, new, pos, per_row: bool):
+    """Write ``new`` ``[B, Hkv, S, Dh]`` into ``cache`` ``[B, Hkv, T, Dh]``
+    at time offset ``pos`` — one shared scalar offset (plain
+    dynamic_update_slice, the fast path) or one offset PER ROW (vmapped;
+    batched speculative decoding's rows advance independently)."""
+    if not per_row:
+        return jax.lax.dynamic_update_slice_in_dim(cache, new, pos, axis=2)
+    return jax.vmap(
+        lambda c, n, p: jax.lax.dynamic_update_slice_in_dim(c, n, p, axis=1)
+    )(cache, new, pos)
+
+
 def _rope_angles(positions, dh: int):
     """RoPE angles for absolute ``positions`` ``[...]`` → ``(cos, sin)``
     each ``[..., dh/2]`` (Su et al. 2021, base 10000)."""
@@ -460,18 +516,22 @@ class TransformerLM:
 
     def decode_step(self, params, token, pos, cache):
         """One cached decode step: ``token`` ``[B]`` int at absolute
-        position ``pos`` (scalar) → ``(logits [B, V] f32, new_cache)``.
-        Attends over cache positions ``0..pos``; for the dense model this
-        is bit-close to the teacher-forced forward one position at a time.
-        The MoE variant routes each decoded position as its OWN dispatch
-        group (the causally correct choice — no future competition), which
-        intentionally differs from teacher-forced whole-block routing."""
+        position ``pos`` (scalar, or per-row ``[B]`` — batched speculative
+        decoding advances rows independently) → ``(logits [B, V] f32,
+        new_cache)``. Attends over cache positions ``0..pos``; for the
+        dense model this is bit-close to the teacher-forced forward one
+        position at a time. The MoE variant routes each decoded position
+        as its OWN dispatch group (the causally correct choice — no future
+        competition), which intentionally differs from teacher-forced
+        whole-block routing."""
         B = token.shape[0]
         H = self.n_heads
         Hkv = self.n_kv_heads
         Dh = self.d_model // H
         cd = self.compute_dtype
-        pos_b = jnp.broadcast_to(jnp.asarray(pos), (B,))
+        pos = jnp.asarray(pos)
+        per_row = pos.ndim == 1
+        pos_b = jnp.broadcast_to(pos, (B,))
         h = self._embed(params, token, pos_b)  # [B, D]
         if self.pos_encoding == "rotary":
             r_cos, r_sin = _rope_angles(pos_b, Dh)  # [B, Dh/2]
@@ -489,8 +549,8 @@ class TransformerLM:
                 # cache stores PRE-ROTATED keys (prefill does the same)
                 q = _rope_rotate(q, r_cos, r_sin)
                 k_new = _rope_rotate(k_new, r_cos[:, None], r_sin[:, None])
-            kc = jax.lax.dynamic_update_slice_in_dim(kc, k_new, pos, axis=2)
-            vc = jax.lax.dynamic_update_slice_in_dim(vc, v_new, pos, axis=2)
+            kc = _cache_update_rows(kc, k_new, pos, per_row)
+            vc = _cache_update_rows(vc, v_new, pos, per_row)
             # grouped attention straight against the Hkv-head cache (query
             # head h = kv_head·G + g, matching the repeat layout the
             # training paths broadcast to): flash-decode Pallas kernel on
@@ -523,7 +583,8 @@ class TransformerLM:
         into the cache first, then attends each query against cache
         positions ``0..its own position`` — so a chunk starting at the
         first stale cache position also *repairs* it (see
-        :meth:`generate_speculative`'s invariant). ``pos0`` may be traced.
+        :meth:`generate_speculative`'s invariant). ``pos0`` may be traced,
+        and may be per-row ``[B]`` (batched speculative verification).
         Like :meth:`decode_step`, the MoE variant routes the chunk as its
         own dispatch group."""
         B, S = tokens.shape
@@ -532,12 +593,15 @@ class TransformerLM:
         Dh = self.d_model // H
         cd = self.compute_dtype
         T = cache["k"].shape[3]
-        positions = jnp.asarray(pos0) + jnp.arange(S)  # [S]
-        pos_b = jnp.broadcast_to(positions, (B, S))
+        pos0 = jnp.asarray(pos0)
+        per_row = pos0.ndim == 1
+        pos_b = jnp.broadcast_to(pos0.reshape(-1, 1), (B, 1)) + \
+            jnp.arange(S)[None, :]  # [B, S] absolute positions per row
         h = self._embed(params, tokens, pos_b)  # [B, S, D]
         rope = self._rope_for(pos_b)
-        # [S, T] causal-vs-cache mask: query i sees cache j <= pos0+i
-        mask = jnp.arange(T)[None, :] <= positions[:, None]
+        # [B, S, T] causal-vs-cache mask: row b's query i sees cache
+        # j <= pos0_b + i
+        mask = jnp.arange(T)[None, None, :] <= pos_b[:, :, None]
 
         def block(h, inputs):
             lp, kc, vc = inputs  # layer params; cache slices [B, Hkv, T, Dh]
@@ -550,10 +614,10 @@ class TransformerLM:
             if rope is not None:
                 q = _rope_rotate(q, *rope)
                 k_new = _rope_rotate(k_new, *rope)
-            kc = jax.lax.dynamic_update_slice_in_dim(
-                kc, k_new.transpose(0, 2, 1, 3), pos0, axis=2)
-            vc = jax.lax.dynamic_update_slice_in_dim(
-                vc, v_new.transpose(0, 2, 1, 3), pos0, axis=2)
+            kc = _cache_update_rows(
+                kc, k_new.transpose(0, 2, 1, 3), pos0, per_row)
+            vc = _cache_update_rows(
+                vc, v_new.transpose(0, 2, 1, 3), pos0, per_row)
             # grouped attention against the Hkv-head cache, all S queries
             # at once (S is small — the dense [S, T] score block is cheap
             # and hits the MXU as a matrix-matrix product)
@@ -563,7 +627,7 @@ class TransformerLM:
                 preferred_element_type=jnp.float32,
                 precision=jax.lax.Precision.HIGHEST,
             ) * (Dh ** -0.5)
-            scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+            scores = jnp.where(mask[:, None, None], scores, -jnp.inf)
             probs = jax.nn.softmax(scores, axis=-1)
             a = jnp.einsum(
                 "bkgst,bktd->bkgsd", probs, vc,
@@ -607,8 +671,12 @@ class TransformerLM:
         of them before any query can attend there (chunk length
         ``spec_k+1``, acceptance advances by at most ``n+1``).
 
-        Batch 1 only (per-row accept counts diverge); the draft shares the
-        target's vocabulary; proposals use plain temperature sampling
+        Batches of any size: ``B > 1`` routes to the per-row-position
+        batched loop (:meth:`_generate_speculative_batched` — rows accept
+        different prefix lengths, so each carries its own absolute
+        position through the caches; greedy per-row output still equals
+        the target's own rollout). The draft shares the target's
+        vocabulary; proposals use plain temperature sampling
         (no top-k/top-p). Latency-oriented: fewer sequential target steps
         per emitted token at the cost of draft work — the win grows with
         the target/draft size ratio. ``with_stats=True`` additionally
@@ -638,10 +706,6 @@ class TransformerLM:
             )
         prompt = jnp.asarray(prompt, jnp.int32)
         B, T0 = prompt.shape
-        if B != 1:
-            raise ValueError(
-                f"speculative decoding supports batch 1, got batch {B}"
-            )
         if draft.vocab != self.vocab:
             raise ValueError(
                 f"draft vocab {draft.vocab} != target vocab {self.vocab}"
@@ -656,6 +720,11 @@ class TransformerLM:
             )
         if n_new < 1:
             return prompt
+        if B != 1:
+            return self._generate_speculative_batched(
+                params, prompt, int(n_new), draft, draft_params,
+                int(spec_k), float(temperature), int(seed), with_stats,
+            )
 
         horizon = total + spec_k + 1
         t_logits, t_cache = self.prefill(params, prompt,
@@ -664,16 +733,11 @@ class TransformerLM:
                                    draft.init_cache(1, horizon))
         rng = np.random.default_rng(seed)
 
-        def host_probs(logits_row):
-            x = np.asarray(logits_row, np.float64) / temperature
-            x -= x.max()
-            e = np.exp(x)
-            return e / e.sum()
-
         def choose(logits_row):
             if temperature <= 0.0:
                 return int(np.argmax(np.asarray(logits_row)))
-            return int(rng.choice(self.vocab, p=host_probs(logits_row)))
+            return int(rng.choice(
+                self.vocab, p=_spec_probs(logits_row, temperature)))
 
         draft_step = jax.jit(draft.decode_step)
         verify = jax.jit(self.decode_chunk)
@@ -694,7 +758,7 @@ class TransformerLM:
                                          jnp.asarray([tok], jnp.int32),
                                          p, d_cache)
                 if temperature > 0.0:
-                    row = host_probs(dl[0])
+                    row = _spec_probs(dl[0], temperature)
                     tok = int(rng.choice(self.vocab, p=row))
                     d_probs.append(row)
                 else:
@@ -707,31 +771,8 @@ class TransformerLM:
             vl, t_cache = verify(params, chunk, pos, t_cache)
             vl = np.asarray(vl[0], np.float32)  # [spec_k+1, V]
 
-            if temperature <= 0.0:
-                t_arg = vl.argmax(axis=-1)
-                n = 0
-                while n < spec_k and int(t_arg[n]) == d_toks[n]:
-                    n += 1
-                emitted = d_toks[:n] + [int(t_arg[n])]
-            else:
-                n = 0
-                emitted = None
-                for i in range(spec_k):
-                    pt = host_probs(vl[i])
-                    pd = d_probs[i]
-                    d = d_toks[i]
-                    if rng.random() < min(1.0, pt[d] / max(pd[d], 1e-20)):
-                        n += 1
-                        continue
-                    resid = np.maximum(pt - pd, 0.0)
-                    z = resid.sum()
-                    resid = resid / z if z > 0 else pt
-                    emitted = d_toks[:n] + [int(rng.choice(self.vocab,
-                                                           p=resid))]
-                    break
-                if emitted is None:  # all accepted → bonus from the target
-                    emitted = d_toks + [int(rng.choice(
-                        self.vocab, p=host_probs(vl[spec_k])))]
+            emitted, n = _spec_accept_row(
+                vl, d_toks, d_probs, spec_k, self.vocab, temperature, rng)
             if n == spec_k and len(emitted) == spec_k + 1:
                 # Full acceptance: the last draft token d_k was PROPOSED but
                 # never ingested by the draft (its K/V at position pos+k
@@ -758,6 +799,123 @@ class TransformerLM:
                 "accepted": accepted,
                 "acceptance_rate": accepted / max(proposed, 1),
                 "tokens_emitted": int(total - T0),
+            }
+        return tokens
+
+    def _generate_speculative_batched(self, params, prompt, n_new: int,
+                                      draft, draft_params, spec_k: int,
+                                      temperature: float, seed: int,
+                                      with_stats: bool):
+        """Batched (B>1) speculative decoding via per-row positions.
+
+        Rows accept different prefix lengths per round, so each row carries
+        its OWN absolute position: the draft steps and the verify chunk run
+        batched with per-row ``pos`` (``decode_step``/``decode_chunk``
+        accept ``[B]`` positions; the flash-decode kernel takes a per-row
+        visibility bound). A finished row freezes: its position clamps to
+        ``total-1`` (keeping every later round's cache writes inside the
+        allocated horizon, with no reliance on update-slice index
+        clamping) and later rounds rewrite that span in place — harmless,
+        the row's output is already final — while unfinished rows keep
+        proposing, so every round costs one verify pass for the whole
+        batch.
+
+        The last draft proposal is ingested into the draft cache for EVERY
+        row each round (the batch-1 path ingests only on full acceptance):
+        for rows that rejected earlier, the write lands beyond their next
+        round's start and is overwritten by that round's own draft steps
+        before any query can attend it — the same staleness-repair
+        invariant :meth:`generate_speculative` documents, extended one slot.
+
+        Greedy (``temperature=0``) output equals per-row batch-1 greedy
+        speculative decoding (= the target's own greedy rollout). Sampling
+        uses an independent stream per row (``default_rng([seed, row])``) —
+        deterministic per seed, but not the batch-1 stream.
+        """
+        B, T0 = prompt.shape
+        total = T0 + n_new
+        horizon = total + spec_k + 1
+        t_logits, t_cache = self.prefill(params, prompt,
+                                         self.init_cache(B, horizon))
+        _, d_cache = draft.prefill(draft_params, prompt,
+                                   draft.init_cache(B, horizon))
+        rngs = [np.random.default_rng([seed, b]) for b in range(B)]
+
+        out = [list(np.asarray(prompt[b])) for b in range(B)]
+        carry = np.empty((B,), np.int64)
+        last = np.asarray(t_logits[:, -1])
+        for b in range(B):
+            carry[b] = (
+                int(np.argmax(last[b])) if temperature <= 0.0
+                else int(rngs[b].choice(
+                    self.vocab, p=_spec_probs(last[b], temperature)))
+            )
+            out[b].append(int(carry[b]))
+        pos = np.full((B,), T0, np.int64)
+        rounds = proposed = accepted = 0
+
+        draft_step = jax.jit(draft.decode_step)
+        verify = jax.jit(self.decode_chunk)
+
+        while min(len(o) for o in out) < total:
+            rounds += 1
+            active = np.array([len(o) < total for o in out])
+
+            # -- draft proposals, batched, per-row positions --------------
+            d_toks = np.empty((B, spec_k), np.int64)
+            d_probs = [[None] * spec_k for _ in range(B)]
+            tok, p = carry.copy(), pos.copy()
+            for i in range(spec_k):
+                dl, d_cache = draft_step(
+                    draft_params, jnp.asarray(tok, jnp.int32),
+                    jnp.asarray(p), d_cache)
+                dlh = np.asarray(dl)
+                for b in range(B):
+                    if temperature > 0.0:
+                        row = _spec_probs(dlh[b], temperature)
+                        d_probs[b][i] = row
+                        tok[b] = int(rngs[b].choice(self.vocab, p=row))
+                    else:
+                        tok[b] = int(np.argmax(dlh[b]))
+                d_toks[:, i] = tok
+                p += 1
+
+            # -- target verifies every row's block in one pass ------------
+            chunk = np.concatenate([carry[:, None], d_toks], 1)
+            vl, t_cache = verify(params, jnp.asarray(chunk, jnp.int32),
+                                 jnp.asarray(pos), t_cache)
+            vlh = np.asarray(vl, np.float32)  # [B, spec_k+1, V]
+
+            # -- per-row acceptance (the SAME rule function as batch 1) ---
+            for b in range(B):
+                emitted, n = _spec_accept_row(
+                    vlh[b], d_toks[b], d_probs[b], spec_k, self.vocab,
+                    temperature, rngs[b])
+                if active[b]:
+                    proposed += spec_k
+                    accepted += n
+                    out[b].extend(emitted)
+                    # clamp a row that just finished: later rounds keep
+                    # writing its (now-final) span without growing past
+                    # the allocated cache horizon
+                    pos[b] = min(pos[b] + len(emitted), total - 1)
+                    carry[b] = emitted[-1]
+                # frozen rows: position, carry, and output stay put
+
+            # -- ingest the last proposal into the draft cache for ALL
+            # rows (see docstring for why spurious writes are safe)
+            _, d_cache = draft_step(draft_params,
+                                    jnp.asarray(d_toks[:, -1], jnp.int32),
+                                    jnp.asarray(p), d_cache)
+
+        tokens = jnp.asarray([o[:total] for o in out], jnp.int32)
+        if with_stats:
+            return tokens, {
+                "rounds": rounds,
+                "proposed": proposed,
+                "accepted": accepted,
+                "acceptance_rate": accepted / max(proposed, 1),
+                "tokens_emitted": int(B * (total - T0)),
             }
         return tokens
 

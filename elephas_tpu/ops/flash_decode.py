@@ -54,10 +54,11 @@ def aligned_cache_length(length: int) -> int:
 def decode_attention_reference(q, k, v, pos):
     """Grouped decode attention against a cache.
 
-    ``q`` [B, Hkv, G, Dh]; ``k``/``v`` [B, Hkv, T, Dh]; ``pos`` scalar int —
-    positions ``0..pos`` (inclusive) are visible. Returns [B, Hkv, G, Dh]
-    float32, softmax in f32. One body serves this and the lse-exposing
-    variant (same dedup rationale as the Pallas side).
+    ``q`` [B, Hkv, G, Dh]; ``k``/``v`` [B, Hkv, T, Dh]; ``pos`` scalar int
+    or per-row ``[B]`` int (batched speculative decoding advances rows at
+    different positions) — row b sees positions ``0..pos[b]`` inclusive.
+    Returns [B, Hkv, G, Dh] float32, softmax in f32. One body serves this
+    and the lse-exposing variant (same dedup rationale as the Pallas side).
     """
     return decode_attention_reference_lse(q, k, v, pos)[0]
 
@@ -102,7 +103,8 @@ def decode_attention_reference_lse(q, k, v, pos):
         "bkgd,bktd->bkgt", q, k, preferred_element_type=jnp.float32,
         precision=jax.lax.Precision.HIGHEST,
     ) * (dh ** -0.5)
-    mask = jnp.arange(k.shape[2])[None, None, None, :] <= pos
+    pos_rows = jnp.asarray(pos).reshape(-1, 1, 1, 1)  # scalar or per-row [B]
+    mask = jnp.arange(k.shape[2])[None, None, None, :] <= pos_rows
     scores = jnp.where(mask, scores, -jnp.inf)
     m = jnp.max(scores, axis=-1)
     p = jnp.exp(scores - m[..., None])
@@ -116,9 +118,14 @@ def decode_attention_reference_lse(q, k, v, pos):
 
 def _decode_kernel_lse(d_true: int, block_t: int, pos_ref, q_ref, k_ref,
                        v_ref, o_ref, lse_ref, m_s, l_s, acc_s):
-    """:func:`_decode_kernel` plus an lse output (lane-broadcast)."""
+    """Online-softmax decode kernel with an lse output (lane-broadcast).
+
+    ``pos_ref`` is per-row ``[B]`` (scalar callers broadcast): the batch
+    grid dimension picks its own visibility bound, which is what batched
+    speculative decoding needs when rows sit at different positions."""
     from jax.experimental import pallas as pl
 
+    b = pl.program_id(0)
     t = pl.program_id(2)
 
     @pl.when(t == 0)
@@ -129,7 +136,7 @@ def _decode_kernel_lse(d_true: int, block_t: int, pos_ref, q_ref, k_ref,
 
     start = t * block_t
 
-    @pl.when(start <= pos_ref[0])
+    @pl.when(start <= pos_ref[b])
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32)
         k = k_ref[0, 0].astype(jnp.float32)
@@ -140,7 +147,7 @@ def _decode_kernel_lse(d_true: int, block_t: int, pos_ref, q_ref, k_ref,
             precision=jax.lax.Precision.HIGHEST,
         ) * (d_true ** -0.5)
         j = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(j <= pos_ref[0], s, _NEG)
+        s = jnp.where(j <= pos_ref[b], s, _NEG)
         m_prev = m_s[:, :1]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_cur)
@@ -160,9 +167,10 @@ def _decode_kernel_lse(d_true: int, block_t: int, pos_ref, q_ref, k_ref,
 
 
 def flash_decode_lse(q, k, v, pos, interpret: bool = False):
-    """Fused decode attention returning ``(out, lse)``; ``pos`` must be
-    ``>= 0`` (a rank with nothing visible clamps pos and overrides its lse
-    to −inf outside the kernel — see models/sharded_generate.py)."""
+    """Fused decode attention returning ``(out, lse)``; ``pos`` (scalar or
+    per-row ``[B]``) must be ``>= 0`` (a rank with nothing visible clamps
+    pos and overrides its lse to −inf outside the kernel — see
+    models/sharded_generate.py)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -174,7 +182,7 @@ def flash_decode_lse(q, k, v, pos, interpret: bool = False):
     qp = jnp.pad(q.astype(jnp.float32), ((0, 0), (0, 0), (0, Gp - G), (0, 0)))
     kp = jnp.pad(k, ((0, 0), (0, 0), (0, Tp - T), (0, 0))) if Tp != T else k
     vp = jnp.pad(v, ((0, 0), (0, 0), (0, Tp - T), (0, 0))) if Tp != T else v
-    pos_arr = jnp.asarray(pos, jnp.int32).reshape(1)
+    pos_arr = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,))
     n_t = Tp // bt
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -182,13 +190,14 @@ def flash_decode_lse(q, k, v, pos, interpret: bool = False):
         grid=(B, Hkv, n_t),
         in_specs=[
             pl.BlockSpec((1, 1, Gp, Dh), lambda b, h, t, s: (b, h, 0, 0)),
+            # blocks past row b's pos are never DMA'd
             pl.BlockSpec(
                 (1, 1, bt, Dh),
-                lambda b, h, t, s: (b, h, jnp.minimum(t, s[0] // bt), 0),
+                lambda b, h, t, s: (b, h, jnp.minimum(t, s[b] // bt), 0),
             ),
             pl.BlockSpec(
                 (1, 1, bt, Dh),
-                lambda b, h, t, s: (b, h, jnp.minimum(t, s[0] // bt), 0),
+                lambda b, h, t, s: (b, h, jnp.minimum(t, s[b] // bt), 0),
             ),
         ],
         out_specs=[

@@ -170,10 +170,7 @@ def test_speculative_validation():
     t_params = _params(target, 0)
     draft = _model(max_len=8)
     d_params = _params(draft, 1)
-    with pytest.raises(ValueError, match="batch 1"):
-        target.generate_speculative(t_params, np.zeros((2, 2), np.int32),
-                                    n_new=2, draft=draft,
-                                    draft_params=d_params)
+    # B>1 is now supported (batched per-row positions) — no batch error.
     bad_draft = _model(vocab=19, max_len=8)
     with pytest.raises(ValueError, match="vocab"):
         target.generate_speculative(t_params, np.zeros((1, 2), np.int32),
@@ -208,3 +205,61 @@ def test_with_stats_contract():
     # every round emits >= 1 token (first token comes from the prefill)
     assert stats["rounds"] >= (14 - 1) // (3 + 1)
     assert stats["rounds"] <= 14
+
+
+def test_batched_greedy_equals_per_row_rollout():
+    """B>1 speculative greedy: every row equals the target's own greedy
+    generate — per-row positions, frozen finished rows, and the
+    always-ingest draft-cache policy must not leak across rows."""
+    target = _model(pos_encoding="rotary")
+    draft = _model(d_model=8, n_heads=2, d_ff=16, pos_encoding="rotary")
+    tp, dp = _params(target, 5), _params(draft, 6)
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, 17, size=(3, 5)).astype(np.int32)
+    n_new = 13
+
+    got = np.asarray(target.generate_speculative(
+        tp, prompt, n_new, draft, dp, spec_k=3))
+    want = np.asarray(target.generate(tp, prompt, n_new))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_batched_equals_batch1_rows():
+    """Each batched row reproduces its own batch-1 speculative run
+    (greedy)."""
+    target = _model()
+    draft = _model(d_model=8, n_heads=2, d_ff=16)
+    tp, dp = _params(target, 7), _params(draft, 8)
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, 17, size=(2, 4)).astype(np.int32)
+
+    batched = np.asarray(target.generate_speculative(
+        tp, prompt, 11, draft, dp, spec_k=4))
+    for b in range(2):
+        solo = np.asarray(target.generate_speculative(
+            tp, prompt[b:b + 1], 11, draft, dp, spec_k=4))
+        np.testing.assert_array_equal(batched[b:b + 1], solo,
+                                      err_msg=f"row {b}")
+
+
+def test_batched_sampled_contract():
+    """Sampled batched decoding: deterministic per seed, in-vocab, right
+    shape, consistent stats."""
+    target = _model()
+    draft = _model(d_model=8, n_heads=2, d_ff=16)
+    tp, dp = _params(target, 9), _params(draft, 10)
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(0, 17, size=(3, 4)).astype(np.int32)
+
+    a, stats = target.generate_speculative(
+        tp, prompt, 9, draft, dp, spec_k=3, temperature=0.9, seed=4,
+        with_stats=True)
+    b = target.generate_speculative(
+        tp, prompt, 9, draft, dp, spec_k=3, temperature=0.9, seed=4)
+    a, b = np.asarray(a), np.asarray(b)
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (3, 13)
+    assert (0 <= a).all() and (a < 17).all()
+    np.testing.assert_array_equal(a[:, :4], prompt)
+    assert 0 <= stats["accepted"] <= stats["proposed"]
+    assert stats["tokens_emitted"] == 3 * 9

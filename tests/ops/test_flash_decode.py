@@ -150,3 +150,21 @@ def test_lse_merge_reconstructs_full_attention():
         want = decode_attention_reference(q, k, v, pos)
         np.testing.assert_allclose(merged, want, atol=1e-5, rtol=1e-5,
                                    err_msg=f"pos={pos}")
+
+
+def test_per_row_positions():
+    """pos as a [B] vector: each row's visibility bound is independent
+    (the batched-speculative-decoding contract) and equals per-row scalar
+    calls."""
+    rng = np.random.default_rng(7)
+    B, Hkv, G, Dh, T = 4, 2, 2, 16, 64
+    q = rand(rng, B, Hkv, G, Dh)
+    k = rand(rng, B, Hkv, T, Dh)
+    v = rand(rng, B, Hkv, T, Dh)
+    pos = np.array([0, 13, 31, 63], np.int32)
+    got = fused(q, k, v, jnp.asarray(pos))
+    for b in range(B):
+        want_b = decode_attention_reference(q[b:b + 1], k[b:b + 1],
+                                            v[b:b + 1], int(pos[b]))
+        np.testing.assert_allclose(got[b:b + 1], want_b, atol=1e-5,
+                                   rtol=1e-5, err_msg=f"row {b}")
