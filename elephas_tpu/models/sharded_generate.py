@@ -32,9 +32,14 @@ stay in lockstep without a broadcast.
 Prefill runs the full (matrix-matrix) forward per data rank, then each seq
 rank keeps only its slice of the prompt K/V — prompt-length activations
 appear transiently on every rank (same as single-chip prefill), but the
-*standing* cache is sharded. Dense models only: the MoE variant's expert
-stacks shard over "seq" and need the all_to_all decode path (tracked
-limitation).
+*standing* cache is sharded. The MoE variant works too: its expert
+stacks already shard over this same ``"seq"`` axis, and every FFN call
+runs under a non-``"dense"`` tag so routing dispatches through the two
+``all_to_all``s against the LOCAL expert shards (each rank routes its
+identical replicated tokens, so the combined outputs stay replicated and
+no expert weights are ever gathered). MoE capacity semantics are
+per-rank dispatch groups — identical keep/drop to the gathered rollout
+whenever capacity does not bind (see the tests).
 
 Exactness: the logsumexp merge is algebraically the same softmax attention
 the single-device path computes, so greedy sharded generation reproduces
@@ -86,13 +91,17 @@ def build_lm_generate(model: TransformerLM, mesh: Mesh,
     gathered. One program is compiled per ``(B, T0, n_new)`` geometry and
     cached on the returned function.
     """
+    # Params may be replicated or sharded over THIS program's "seq" axis
+    # (the MoE expert stacks) — anything else has no home here.
     for name, spec in model.specs().items():
-        if spec != P():
-            raise NotImplementedError(
-                f"sharded generate supports dense (replicated-param) models; "
-                f"param {name!r} has spec {spec} (MoE expert stacks need the "
-                f"all_to_all decode path)"
-            )
+        for ax in spec:
+            axes = ax if isinstance(ax, tuple) else (ax,)
+            for a in axes:
+                if a not in (None, SEQ_AXIS):
+                    raise NotImplementedError(
+                        f"sharded generate shards over {SEQ_AXIS!r}; param "
+                        f"{name!r} has spec {spec}"
+                    )
     if DATA_AXIS not in mesh.shape or SEQ_AXIS not in mesh.shape:
         raise ValueError(
             f"mesh must carry ({DATA_AXIS!r}, {SEQ_AXIS!r}) axes, got "
@@ -106,6 +115,13 @@ def build_lm_generate(model: TransformerLM, mesh: Mesh,
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
 
     sp = mesh.shape[SEQ_AXIS]
+    n_experts = getattr(model, "n_experts", None)
+    if n_experts is not None and n_experts % sp:
+        # same build-time clarity the training builder gives — otherwise
+        # this surfaces as a cryptic all_to_all divisibility error later
+        raise ValueError(
+            f"n_experts={n_experts} not divisible by seq axis size {sp}"
+        )
     dp = mesh.shape[DATA_AXIS]
     H = model.n_heads
     Hkv = model.n_kv_heads
@@ -175,7 +191,12 @@ def build_lm_generate(model: TransformerLM, mesh: Mesh,
             x = _layer_norm(
                 h.astype(jnp.float32), lp["ln2_s"], lp["ln2_b"]
             ).astype(cd)
-            out, _ = model._ffn(lp, x[:, None, :], "dense", SEQ_AXIS,
+            # Non-"dense" tag: the MoE variant's experts dispatch over the
+            # LIVE seq axis (all_to_all against the local expert shards —
+            # every rank routes its identical replicated tokens, so the
+            # combined outputs stay replicated); the dense FFN ignores the
+            # tag entirely.
+            out, _ = model._ffn(lp, x[:, None, :], "ring", SEQ_AXIS,
                                 ep_groups=1)
             return h + out[:, 0].astype(cd), (kc, vc)
 
@@ -190,8 +211,10 @@ def build_lm_generate(model: TransformerLM, mesh: Mesh,
         B, T0 = prompt.shape
         r = jax.lax.axis_index(SEQ_AXIS)
 
-        # Prefill the full prompt (matrix-matrix, per data rank), then keep
-        # only this rank's cache slice. The prefill K/V is padded to a
+        # Prefill the full prompt (matrix-matrix; attention replicated per
+        # data rank, the FFN under a non-"dense" tag so MoE experts
+        # dispatch over the live seq axis against their LOCAL shards), then
+        # keep only this rank's cache slice. The prefill K/V is padded to a
         # multiple of Tl so every slice start is exact: ranks at or past the
         # padded length slice garbage that position masking keeps invisible
         # until a decode write lands there.
@@ -200,7 +223,7 @@ def build_lm_generate(model: TransformerLM, mesh: Mesh,
             "k": jnp.zeros((model.n_layers, B, Hkv, p_up, Dh), cd),
             "v": jnp.zeros((model.n_layers, B, Hkv, p_up, Dh), cd),
         }
-        logits, tmp = model.prefill(params, prompt, tmp)
+        logits, tmp = model.prefill(params, prompt, tmp, ffn_tag="ring")
         start = jnp.minimum(r * Tl, p_up - Tl)
         kcache = jax.lax.dynamic_slice_in_dim(tmp["k"], start, Tl, axis=3)
         vcache = jax.lax.dynamic_slice_in_dim(tmp["v"], start, Tl, axis=3)
@@ -258,7 +281,7 @@ def build_lm_generate(model: TransformerLM, mesh: Mesh,
         Tl = _local_cache_len(total, sp)
         geom = (B, T0, int(n_new))
         if geom not in programs:
-            pspecs = {k: P() for k in model.param_shapes()}
+            pspecs = model.specs()  # replicated; MoE experts over "seq"
             programs[geom] = jax.jit(
                 jax.shard_map(
                     functools.partial(_gen_impl, total, Tl),

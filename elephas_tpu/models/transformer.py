@@ -476,10 +476,17 @@ class TransformerLM:
         z = jnp.zeros(shape, self.compute_dtype)
         return {"k": z, "v": z}
 
-    def prefill(self, params, tokens, cache):
+    def prefill(self, params, tokens, cache, ffn_tag: str = "dense"):
         """Batched prompt ingestion: run the full (matrix-matrix) forward
         over ``tokens`` ``[B, T0]``, writing every position's K/V into
-        ``cache`` at offset 0. Returns ``(logits [B, T0, V], cache)``."""
+        ``cache`` at offset 0. Returns ``(logits [B, T0, V], cache)``.
+
+        ``ffn_tag`` routes the per-block FFN: ``"dense"`` (default) is the
+        single-device path (MoE uses its full-expert-stack oracle); a
+        non-dense tag makes the MoE FFN dispatch over the LIVE ``"seq"``
+        mesh axis against local expert shards — what
+        ``models/sharded_generate.py`` passes. The attention math is
+        identical either way (the tag only reaches ``_ffn``)."""
         B, T0 = tokens.shape
         positions = jnp.broadcast_to(jnp.arange(T0), (B, T0))
         h = self._embed(params, tokens, positions)
@@ -498,7 +505,7 @@ class TransformerLM:
         def block(h, lp):
             h, _, k, v = self._block_fwd(
                 h, lp, prefill_attend,
-                "dense", SEQ_AXIS, ep_groups=1, rope=rope,
+                ffn_tag, SEQ_AXIS, ep_groups=1, rope=rope,
             )
             return h, (k, v)
 
