@@ -29,7 +29,8 @@ import jax.numpy as jnp
 import numpy as np
 
 # [*, in, out]-shaped matmul weights → scales on the last (output) axis.
-_LAST_AXIS_KEYS = ("wq", "wk", "wv", "wo", "w1", "w2", "head")
+# (w3 = the swiglu gate's up-projection on hf_import-style models.)
+_LAST_AXIS_KEYS = ("wq", "wk", "wv", "wo", "w1", "w2", "w3", "head")
 # token embedding [V, D] → scales per vocab row (axis 0) so __getitem__
 # dequantizes only the gathered rows; ``tok.T`` (tied logits) then carries
 # per-output-channel scales, which is exactly the right layout there too.

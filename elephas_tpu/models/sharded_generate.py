@@ -64,7 +64,6 @@ from ..parallel.mesh import DATA_AXIS
 from .transformer import (
     SEQ_AXIS,
     TransformerLM,
-    _layer_norm,
     _rope_angles,
     _rope_rotate,
     select_tokens,
@@ -160,17 +159,15 @@ def build_lm_generate(model: TransformerLM, mesh: Mesh,
         pos_b = jnp.broadcast_to(p, (B,))
         h = model._embed(params, token, pos_b)       # [B, D]
         if model.pos_encoding == "rotary":
-            r_cos, r_sin = _rope_angles(pos_b, Dh)
+            r_cos, r_sin = _rope_angles(pos_b, Dh, model.rope_theta)
             r_cos, r_sin = r_cos[:, None, :], r_sin[:, None, :]
 
         def block(h, inputs):
             lp, kc, vc = inputs                      # kc/vc [B, Hkv, Tl, Dh]
-            x = _layer_norm(
-                h.astype(jnp.float32), lp["ln1_s"], lp["ln1_b"]
-            ).astype(cd)
-            q = (x @ lp["wq"].astype(cd)).reshape(B, H, Dh)
-            k_new = (x @ lp["wk"].astype(cd)).reshape(B, Hkv, 1, Dh)
-            v_new = (x @ lp["wv"].astype(cd)).reshape(B, Hkv, 1, Dh)
+            x = model._norm_h(lp, "ln1", h).astype(cd)
+            q = model._attn_proj(lp, "q", x).reshape(B, H, Dh)
+            k_new = model._attn_proj(lp, "k", x).reshape(B, Hkv, 1, Dh)
+            v_new = model._attn_proj(lp, "v", x).reshape(B, Hkv, 1, Dh)
             if model.pos_encoding == "rotary":
                 q = _rope_rotate(q, r_cos, r_sin)
                 k_new = _rope_rotate(k_new, r_cos[:, None], r_sin[:, None])
@@ -187,10 +184,8 @@ def build_lm_generate(model: TransformerLM, mesh: Mesh,
             qg = q.reshape(B, Hkv, H // Hkv, Dh)
             a = _merged_decode_attention(qg, kc, vc, pos_local, Tl)
             a = a.astype(cd).reshape(B, H, Dh)
-            h = h + a.reshape(B, model.d_model) @ lp["wo"].astype(cd)
-            x = _layer_norm(
-                h.astype(jnp.float32), lp["ln2_s"], lp["ln2_b"]
-            ).astype(cd)
+            h = h + model._attn_proj(lp, "o", a.reshape(B, model.d_model))
+            x = model._norm_h(lp, "ln2", h).astype(cd)
             # Non-"dense" tag: the MoE variant's experts dispatch over the
             # LIVE seq axis (all_to_all against the local expert shards —
             # every rank routes its identical replicated tokens, so the
@@ -202,8 +197,7 @@ def build_lm_generate(model: TransformerLM, mesh: Mesh,
 
         lps = {k: params[k] for k in model._block_keys()}
         h, (kc_new, vc_new) = jax.lax.scan(block, h, (lps, kcache, vcache))
-        h = _layer_norm(h.astype(jnp.float32), params["lnf_s"],
-                        params["lnf_b"])
+        h = model._norm_h(params, "lnf", h)
         return model._logits(params, h), kc_new, vc_new
 
     def _gen_impl(total: int, Tl: int, params, prompt, key):

@@ -83,6 +83,19 @@ def _validate_tp(model: TransformerLM, mesh: Mesh) -> int:
             "MoE variant shards its experts over the seq axis instead "
             "(build_lm_train_step)"
         )
+    if (model.activation, model.norm, model.attn_bias, model.ffn_bias,
+            model.norm_eps) != ("relu", "layernorm", False, True, 1e-5):
+        # The TP block math below hardcodes the default architecture; the
+        # hf_import families (gelu/swiglu, rmsnorm, biases) generate via
+        # models/sharded_generate.py (any-architecture) instead.
+        raise NotImplementedError(
+            "tensor parallelism currently covers the default architecture "
+            "(relu + layernorm(eps 1e-5) + ffn biases + bias-free "
+            "attention); got "
+            f"activation={model.activation!r} norm={model.norm!r} "
+            f"attn_bias={model.attn_bias} ffn_bias={model.ffn_bias} "
+            f"norm_eps={model.norm_eps}"
+        )
     if DATA_AXIS not in mesh.shape or TP_AXIS not in mesh.shape:
         raise ValueError(
             f"mesh must carry ({DATA_AXIS!r}, {TP_AXIS!r}) axes, got "
@@ -318,7 +331,7 @@ def build_lm_tp_generate(model: TransformerLM, mesh: Mesh,
             pos_b = jnp.broadcast_to(p, (B,))
             h = model._embed(params, token, pos_b)  # [B, D]
             if model.pos_encoding == "rotary":
-                r_cos, r_sin = _rope_angles(pos_b, Dh)
+                r_cos, r_sin = _rope_angles(pos_b, Dh, model.rope_theta)
                 r_cos, r_sin = r_cos[:, None, :], r_sin[:, None, :]
 
             def block(h, inputs):

@@ -183,11 +183,12 @@ def _cache_update_rows(cache, new, pos, per_row: bool):
     )(cache, new, pos)
 
 
-def _rope_angles(positions, dh: int):
+def _rope_angles(positions, dh: int, theta: float = 10000.0):
     """RoPE angles for absolute ``positions`` ``[...]`` → ``(cos, sin)``
-    each ``[..., dh/2]`` (Su et al. 2021, base 10000)."""
+    each ``[..., dh/2]`` (Su et al. 2021; ``theta`` = frequency base —
+    10000 classically, 500000 for Llama-3-family checkpoints)."""
     half = dh // 2
-    inv_freq = 10000.0 ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    inv_freq = float(theta) ** (-jnp.arange(half, dtype=jnp.float32) / half)
     ang = positions.astype(jnp.float32)[..., None] * inv_freq
     return jnp.cos(ang), jnp.sin(ang)
 
@@ -220,7 +221,10 @@ class TransformerLM:
     def __init__(self, vocab: int, d_model: int, n_heads: int, n_layers: int,
                  d_ff: int, max_len: int, compute_dtype: str = "float32",
                  pos_encoding: str = "learned", tie_embeddings: bool = False,
-                 n_kv_heads: Optional[int] = None):
+                 n_kv_heads: Optional[int] = None, activation: str = "relu",
+                 norm: str = "layernorm", norm_eps: float = 1e-5,
+                 attn_bias: bool = False, ffn_bias: bool = True,
+                 rope_theta: float = 10000.0):
         if d_model % n_heads:
             raise ValueError(f"d_model {d_model} not divisible by {n_heads} heads")
         n_kv_heads = n_heads if n_kv_heads is None else int(n_kv_heads)
@@ -236,6 +240,22 @@ class TransformerLM:
                 f"rotary needs an even head dim, got {d_model // n_heads}"
             )
         self.pos_encoding = pos_encoding
+        # Architecture knobs covering the common decoder families (the
+        # defaults reproduce this project's round-1 model exactly):
+        # GPT-2  = gelu + layernorm + attn_bias + ffn_bias + learned pos
+        #          + tied embeddings;
+        # Llama  = swiglu + rmsnorm + no biases + rotary (+ GQA, rope_theta).
+        # models/hf_import.py builds these configs from HF checkpoints.
+        if activation not in ("relu", "gelu", "swiglu"):
+            raise ValueError(f"Unknown activation: {activation}")
+        if norm not in ("layernorm", "rmsnorm"):
+            raise ValueError(f"Unknown norm: {norm}")
+        self.activation = activation
+        self.norm = norm
+        self.norm_eps = float(norm_eps)
+        self.attn_bias = bool(attn_bias)
+        self.ffn_bias = bool(ffn_bias)
+        self.rope_theta = float(rope_theta)
         self.tie_embeddings = bool(tie_embeddings)
         self.vocab = vocab
         self.d_model = d_model
@@ -256,18 +276,32 @@ class TransformerLM:
                          self.max_len)
         f32 = jnp.float32
         sds = jax.ShapeDtypeStruct
+        Dkv = (D // self.n_heads) * self.n_kv_heads
         shapes = {
             "tok": sds((V, D), f32),
             "ln1_s": sds((L, D), f32), "ln1_b": sds((L, D), f32),
             "wq": sds((L, D, D), f32),
-            "wk": sds((L, D, (D // self.n_heads) * self.n_kv_heads), f32),
-            "wv": sds((L, D, (D // self.n_heads) * self.n_kv_heads), f32),
+            "wk": sds((L, D, Dkv), f32),
+            "wv": sds((L, D, Dkv), f32),
             "wo": sds((L, D, D), f32),
             "ln2_s": sds((L, D), f32), "ln2_b": sds((L, D), f32),
             "w1": sds((L, D, F), f32), "b1": sds((L, F), f32),
             "w2": sds((L, F, D), f32), "b2": sds((L, D), f32),
             "lnf_s": sds((D,), f32), "lnf_b": sds((D,), f32),
         }
+        if self.norm == "rmsnorm":  # rmsnorm is scale-only
+            for k in ("ln1_b", "ln2_b", "lnf_b"):
+                del shapes[k]
+        if self.activation == "swiglu":
+            shapes["w3"] = sds((L, D, F), f32)
+        if not self.ffn_bias:
+            for k in ("b1", "b2"):
+                del shapes[k]
+        if self.attn_bias:
+            shapes["bq"] = sds((L, D), f32)
+            shapes["bk"] = sds((L, Dkv), f32)
+            shapes["bv"] = sds((L, Dkv), f32)
+            shapes["bo"] = sds((L, D), f32)
         if not self.tie_embeddings:
             shapes["head"] = sds((D, V), f32)
         if self.pos_encoding == "learned":
@@ -364,8 +398,7 @@ class TransformerLM:
         h, auxes = jax.lax.scan(
             block, h, {k: params[k] for k in self._block_keys()}
         )
-        h = _layer_norm(h.astype(jnp.float32), params["lnf_s"],
-                        params["lnf_b"])
+        h = self._norm_h(params, "lnf", h)
         return self._logits(params, h), jnp.sum(auxes)
 
     def _logits(self, params, h):
@@ -388,7 +421,8 @@ class TransformerLM:
         positions — computed ONCE per forward, outside the layer scan."""
         if self.pos_encoding != "rotary":
             return None
-        cos, sin = _rope_angles(positions, self.d_model // self.n_heads)
+        cos, sin = _rope_angles(positions, self.d_model // self.n_heads,
+                                self.rope_theta)
         return cos[:, :, None, :], sin[:, :, None, :]
 
     def _block_fwd(self, h, lp, attend, attn: str, seq_axis: str,
@@ -410,12 +444,10 @@ class TransformerLM:
         Hkv = self.n_kv_heads
         Dh = self.d_model // H
         cd = self.compute_dtype
-        x = _layer_norm(
-            h.astype(jnp.float32), lp["ln1_s"], lp["ln1_b"]
-        ).astype(cd)
-        q = (x @ lp["wq"].astype(cd)).reshape(B, T, H, Dh)
-        k = (x @ lp["wk"].astype(cd)).reshape(B, T, Hkv, Dh)
-        v = (x @ lp["wv"].astype(cd)).reshape(B, T, Hkv, Dh)
+        x = self._norm_h(lp, "ln1", h).astype(cd)
+        q = self._attn_proj(lp, "q", x).reshape(B, T, H, Dh)
+        k = self._attn_proj(lp, "k", x).reshape(B, T, Hkv, Dh)
+        v = self._attn_proj(lp, "v", x).reshape(B, T, Hkv, Dh)
         if rope is not None and attn == "flash":
             # rotation happens inside the flash attend (fused into the
             # Pallas kernels on TPU — rotated q/k never hit HBM). The
@@ -428,16 +460,42 @@ class TransformerLM:
                 q = _rope_rotate(q, *rope)
                 k = _rope_rotate(k, *rope)
             a = attend(q, k, v).astype(cd)  # ops broadcast KV heads as needed
-        h = h + a.reshape(B, T, self.d_model) @ lp["wo"].astype(cd)
-        x = _layer_norm(
-            h.astype(jnp.float32), lp["ln2_s"], lp["ln2_b"]
-        ).astype(cd)
+        h = h + self._attn_proj(lp, "o", a.reshape(B, T, self.d_model))
+        x = self._norm_h(lp, "ln2", h).astype(cd)
         out, aux = self._ffn(lp, x, attn, seq_axis, ep_groups=ep_groups)
         return h + out.astype(cd), aux, k, v
 
     def _block_keys(self):
-        return ("ln1_s", "ln1_b", "wq", "wk", "wv", "wo",
-                "ln2_s", "ln2_b", "w1", "b1", "w2", "b2")
+        keys = ["ln1_s", "wq", "wk", "wv", "wo", "ln2_s", "w1", "w2"]
+        if self.norm == "layernorm":
+            keys += ["ln1_b", "ln2_b"]
+        if self.ffn_bias:
+            keys += ["b1", "b2"]
+        if self.activation == "swiglu":
+            keys += ["w3"]
+        if self.attn_bias:
+            keys += ["bq", "bk", "bv", "bo"]
+        return tuple(keys)
+
+    def _norm_h(self, lp, prefix: str, x):
+        """Pre/post-block normalization in f32: layernorm (Pallas-fused on
+        TPU) or scale-only rmsnorm per ``self.norm``. ``lp`` is a params
+        dict (stacked layer slice or the top-level dict for ``"lnf"``)."""
+        x32 = x.astype(jnp.float32)
+        s = lp[prefix + "_s"]
+        if self.norm == "rmsnorm":
+            ms = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+            return x32 * jax.lax.rsqrt(ms + self.norm_eps) * s
+        return _layer_norm(x32, s, lp[prefix + "_b"], self.norm_eps)
+
+    def _attn_proj(self, lp, name: str, x):
+        """Attention projection ``x @ w<name>`` (+ ``b<name>`` under
+        ``attn_bias``), in ``x``'s dtype."""
+        cd = x.dtype
+        y = x @ lp["w" + name].astype(cd)
+        if self.attn_bias:
+            y = y + lp["b" + name].astype(cd)
+        return y
 
     def _ffn(self, lp, x, attn: str, seq_axis: str,
              ep_groups: Optional[int] = None):
@@ -448,9 +506,19 @@ class TransformerLM:
         (decode passes 1 — a single position has no groups)."""
         del attn, seq_axis, ep_groups
         cd = x.dtype
-        out = jax.nn.relu(
-            x @ lp["w1"].astype(cd) + lp["b1"].astype(cd)
-        ) @ lp["w2"].astype(cd) + lp["b2"].astype(cd)
+        u = x @ lp["w1"].astype(cd)
+        if self.ffn_bias:
+            u = u + lp["b1"].astype(cd)
+        if self.activation == "swiglu":
+            u = jax.nn.silu(u) * (x @ lp["w3"].astype(cd))
+        elif self.activation == "gelu":
+            # tanh approximation == HF's gelu_new (what GPT-2 trained with)
+            u = jax.nn.gelu(u, approximate=True)
+        else:
+            u = jax.nn.relu(u)
+        out = u @ lp["w2"].astype(cd)
+        if self.ffn_bias:
+            out = out + lp["b2"].astype(cd)
         return out, jnp.asarray(0.0, jnp.float32)
 
     def loss(self, params, tokens, positions, targets, attn="dense",
@@ -517,8 +585,7 @@ class TransformerLM:
             "k": jax.lax.dynamic_update_slice_in_dim(cache["k"], ks, 0, axis=3),
             "v": jax.lax.dynamic_update_slice_in_dim(cache["v"], vs, 0, axis=3),
         }
-        h = _layer_norm(h.astype(jnp.float32), params["lnf_s"],
-                        params["lnf_b"])
+        h = self._norm_h(params, "lnf", h)
         return self._logits(params, h), cache
 
     def decode_step(self, params, token, pos, cache):
@@ -541,17 +608,15 @@ class TransformerLM:
         pos_b = jnp.broadcast_to(pos, (B,))
         h = self._embed(params, token, pos_b)  # [B, D]
         if self.pos_encoding == "rotary":
-            r_cos, r_sin = _rope_angles(pos_b, Dh)  # [B, Dh/2]
+            r_cos, r_sin = _rope_angles(pos_b, Dh, self.rope_theta)
             r_cos, r_sin = r_cos[:, None, :], r_sin[:, None, :]
 
         def block(h, inputs):
             lp, kc, vc = inputs  # layer params; cache slices [B, Hkv, T, Dh]
-            x = _layer_norm(
-                h.astype(jnp.float32), lp["ln1_s"], lp["ln1_b"]
-            ).astype(cd)
-            q = (x @ lp["wq"].astype(cd)).reshape(B, H, Dh)
-            k_new = (x @ lp["wk"].astype(cd)).reshape(B, Hkv, 1, Dh)
-            v_new = (x @ lp["wv"].astype(cd)).reshape(B, Hkv, 1, Dh)
+            x = self._norm_h(lp, "ln1", h).astype(cd)
+            q = self._attn_proj(lp, "q", x).reshape(B, H, Dh)
+            k_new = self._attn_proj(lp, "k", x).reshape(B, Hkv, 1, Dh)
+            v_new = self._attn_proj(lp, "v", x).reshape(B, Hkv, 1, Dh)
             if self.pos_encoding == "rotary":
                 # cache stores PRE-ROTATED keys (prefill does the same)
                 q = _rope_rotate(q, r_cos, r_sin)
@@ -564,10 +629,8 @@ class TransformerLM:
             # TPU (one VMEM pass over the cache), einsum reference elsewhere
             qg = q.reshape(B, Hkv, H // Hkv, Dh)
             a = decode_attention(qg, kc, vc, pos).astype(cd).reshape(B, H, Dh)
-            h = h + a.reshape(B, self.d_model) @ lp["wo"].astype(cd)
-            x = _layer_norm(
-                h.astype(jnp.float32), lp["ln2_s"], lp["ln2_b"]
-            ).astype(cd)
+            h = h + self._attn_proj(lp, "o", a.reshape(B, self.d_model))
+            x = self._norm_h(lp, "ln2", h).astype(cd)
             out, _ = self._ffn(lp, x[:, None, :], "dense", SEQ_AXIS,
                                ep_groups=1)
             return h + out[:, 0].astype(cd), (kc, vc)
@@ -576,8 +639,7 @@ class TransformerLM:
         h, (kc_new, vc_new) = jax.lax.scan(
             block, h, (lps, cache["k"], cache["v"])
         )
-        h = _layer_norm(h.astype(jnp.float32), params["lnf_s"],
-                        params["lnf_b"])
+        h = self._norm_h(params, "lnf", h)
         return self._logits(params, h), {"k": kc_new, "v": vc_new}
 
     def decode_chunk(self, params, tokens, pos0, cache):
@@ -612,12 +674,10 @@ class TransformerLM:
 
         def block(h, inputs):
             lp, kc, vc = inputs  # layer params; cache slices [B, Hkv, T, Dh]
-            x = _layer_norm(
-                h.astype(jnp.float32), lp["ln1_s"], lp["ln1_b"]
-            ).astype(cd)
-            q = (x @ lp["wq"].astype(cd)).reshape(B, S, H, Dh)
-            k_new = (x @ lp["wk"].astype(cd)).reshape(B, S, Hkv, Dh)
-            v_new = (x @ lp["wv"].astype(cd)).reshape(B, S, Hkv, Dh)
+            x = self._norm_h(lp, "ln1", h).astype(cd)
+            q = self._attn_proj(lp, "q", x).reshape(B, S, H, Dh)
+            k_new = self._attn_proj(lp, "k", x).reshape(B, S, Hkv, Dh)
+            v_new = self._attn_proj(lp, "v", x).reshape(B, S, Hkv, Dh)
             if rope is not None:
                 q = _rope_rotate(q, *rope)
                 k_new = _rope_rotate(k_new, *rope)
@@ -642,10 +702,8 @@ class TransformerLM:
                 precision=jax.lax.Precision.HIGHEST,
             ).astype(cd)
             a = a.reshape(B, H, S, Dh).transpose(0, 2, 1, 3)
-            h = h + a.reshape(B, S, self.d_model) @ lp["wo"].astype(cd)
-            x = _layer_norm(
-                h.astype(jnp.float32), lp["ln2_s"], lp["ln2_b"]
-            ).astype(cd)
+            h = h + self._attn_proj(lp, "o", a.reshape(B, S, self.d_model))
+            x = self._norm_h(lp, "ln2", h).astype(cd)
             out, _ = self._ffn(lp, x, "dense", SEQ_AXIS, ep_groups=1)
             return h + out.astype(cd), (kc, vc)
 
@@ -653,8 +711,7 @@ class TransformerLM:
         h, (kc_new, vc_new) = jax.lax.scan(
             block, h, (lps, cache["k"], cache["v"])
         )
-        h = _layer_norm(h.astype(jnp.float32), params["lnf_s"],
-                        params["lnf_b"])
+        h = self._norm_h(params, "lnf", h)
         return self._logits(params, h), {"k": kc_new, "v": vc_new}
 
     def generate_speculative(self, params, prompt, n_new: int,

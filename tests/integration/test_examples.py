@@ -25,6 +25,7 @@ EXAMPLES = [
     "transformer_lm.py",
     "parallelism_tour.py",
     "lm_inference_tour.py",
+    "hf_import_tour.py",
     "sharded_generate.py",
     "resnet50_spark.py",
     "ml_pipeline_notebook.ipynb",  # executed via nbconvert
@@ -35,6 +36,11 @@ EXAMPLES = [
 @pytest.mark.timeout(900)  # resnet50 measures ~134s locally; 900 covers CI
 @pytest.mark.parametrize("script", EXAMPLES)
 def test_example_runs(script):
+    if script == "hf_import_tour.py":
+        # torch/transformers are the import tour's conversion oracle, not
+        # project dependencies (test_hf_import.py importorskips the same way)
+        pytest.importorskip("torch")
+        pytest.importorskip("transformers")
     env = dict(os.environ)
     env.update({
         "PALLAS_AXON_POOL_IPS": "",

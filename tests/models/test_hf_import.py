@@ -1,0 +1,163 @@
+"""HF checkpoint import: logits + greedy-generation parity vs torch.
+
+CPU torch is the independent oracle — tiny randomly initialized HF models
+(GPT-2-, Llama-, GQA-, and bias-variant configs) are converted through
+``models/hf_import.py`` and must reproduce the torch forward pass's logits
+and ``model.generate``'s greedy tokens exactly (float tolerance). This
+doubles as an independent cross-implementation check of the whole
+TransformerLM stack (norms, rope, GQA grouping, gelu/swiglu, caches).
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax
+import jax.numpy as jnp
+
+from elephas_tpu.models.hf_import import lm_from_hf
+
+
+def _hf_logits(hf_model, tokens):
+    with torch.no_grad():
+        out = hf_model(input_ids=torch.tensor(tokens, dtype=torch.long))
+    return out.logits.float().numpy()
+
+
+def _our_logits(model, params, tokens):
+    p = jax.tree.map(jnp.asarray, params)
+    pos = np.broadcast_to(np.arange(tokens.shape[1]), tokens.shape)
+    # Parity is judged at true-f32 matmul precision — JAX's *default*
+    # f32 matmul on CPU/TPU may use reduced-precision passes (a runtime
+    # speed knob, not a property of the imported weights).
+    with jax.default_matmul_precision("float32"):
+        return np.asarray(model.apply(p, tokens, pos))
+
+
+def _assert_logits_close(model, params, hf_model, tokens):
+    ours = _our_logits(model, params, tokens)
+    theirs = _hf_logits(hf_model, tokens)
+    np.testing.assert_allclose(ours, theirs, rtol=2e-4, atol=2e-4)
+
+
+def _assert_greedy_parity(model, params, hf_model, tokens, n_new=6):
+    with torch.no_grad():
+        # explicit all-ones mask: HF otherwise infers padding from
+        # pad_token_id and would mask real tokens that happen to equal it
+        hf_out = hf_model.generate(
+            torch.tensor(tokens, dtype=torch.long), max_new_tokens=n_new,
+            attention_mask=torch.ones(tokens.shape, dtype=torch.long),
+            do_sample=False, eos_token_id=None, pad_token_id=0,
+        ).numpy()
+    p = jax.tree.map(jnp.asarray, params)
+    with jax.default_matmul_precision("float32"):
+        ours = np.asarray(model.generate(p, tokens, n_new))
+    np.testing.assert_array_equal(ours, hf_out)
+
+
+def _tiny_gpt2():
+    torch.manual_seed(7)
+    cfg = transformers.GPT2Config(
+        vocab_size=97, n_positions=64, n_embd=32, n_layer=2, n_head=4,
+        attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0,
+    )
+    m = transformers.GPT2LMHeadModel(cfg)
+    m.eval()
+    return m
+
+
+def _tiny_llama(**over):
+    torch.manual_seed(7)
+    kw = dict(
+        vocab_size=97, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+        max_position_embeddings=64, rope_theta=10000.0,
+        attention_dropout=0.0, tie_word_embeddings=False,
+    )
+    kw.update(over)
+    m = transformers.LlamaForCausalLM(transformers.LlamaConfig(**kw))
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def tokens():
+    rng = np.random.default_rng(0)
+    return rng.integers(0, 97, size=(2, 12)).astype(np.int32)
+
+
+def test_gpt2_logits_parity(tokens):
+    hf = _tiny_gpt2()
+    model, params = lm_from_hf(hf)
+    assert model.activation == "gelu" and model.attn_bias
+    assert model.tie_embeddings and model.pos_encoding == "learned"
+    _assert_logits_close(model, params, hf, tokens)
+
+
+def test_gpt2_greedy_generation_parity(tokens):
+    hf = _tiny_gpt2()
+    model, params = lm_from_hf(hf)
+    _assert_greedy_parity(model, params, hf, tokens)
+
+
+def test_llama_logits_parity(tokens):
+    hf = _tiny_llama()
+    model, params = lm_from_hf(hf)
+    assert model.activation == "swiglu" and model.norm == "rmsnorm"
+    assert not model.ffn_bias and model.pos_encoding == "rotary"
+    _assert_logits_close(model, params, hf, tokens)
+
+
+def test_llama_gqa_logits_parity(tokens):
+    hf = _tiny_llama(num_key_value_heads=2)
+    model, params = lm_from_hf(hf)
+    assert model.n_kv_heads == 2
+    _assert_logits_close(model, params, hf, tokens)
+
+
+def test_llama_attention_bias_variant(tokens):
+    # qwen2-style q/k/v biases via the llama config flag
+    hf = _tiny_llama(attention_bias=True)
+    model, params = lm_from_hf(hf)
+    assert model.attn_bias
+    _assert_logits_close(model, params, hf, tokens)
+
+
+def test_llama_tied_and_theta_variant(tokens):
+    hf = _tiny_llama(tie_word_embeddings=True, rope_theta=500000.0)
+    model, params = lm_from_hf(hf)
+    assert model.tie_embeddings and model.rope_theta == 500000.0
+    _assert_logits_close(model, params, hf, tokens)
+
+
+def test_llama_greedy_generation_parity(tokens):
+    hf = _tiny_llama(num_key_value_heads=2)
+    model, params = lm_from_hf(hf)
+    _assert_greedy_parity(model, params, hf, tokens)
+
+
+def test_imported_model_int8_quantize_still_generates(tokens):
+    # the point of the import: downstream machinery applies unchanged
+    from elephas_tpu.models.quantize import quantize_lm_params
+
+    hf = _tiny_llama()
+    model, params = lm_from_hf(hf)
+    qp = quantize_lm_params(jax.tree.map(jnp.asarray, params))
+    out = np.asarray(model.generate(qp, tokens, 4))
+    assert out.shape == (tokens.shape[0], tokens.shape[1] + 4)
+
+
+def test_unsupported_model_type_raises():
+    hf = _tiny_gpt2()
+    hf.config.model_type = "bloom"
+    with pytest.raises(NotImplementedError, match="model_type"):
+        lm_from_hf(hf)
+
+
+def test_rope_scaling_rejected(tokens):
+    hf = _tiny_llama()
+    hf.config.rope_scaling = {"rope_type": "linear", "factor": 2.0}
+    with pytest.raises(NotImplementedError, match="rope_scaling"):
+        lm_from_hf(hf)
