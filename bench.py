@@ -78,14 +78,23 @@ def lm_train_flops_per_token(model, seq_len: int) -> float:
     Matmul FLOPs only (the MFU convention): 2·params-in-matmuls per token
     forward, ×3 for training (backward ≈ 2× forward). Attention counts the
     FLOPs actually executed under causal masking — each token attends to
-    (T+1)/2 keys on average — NOT the full T², so the reported MFU is the
-    conservative (non-flattered) variant.
+    (T+1)/2 keys on average, or ``min(window, t+1)`` under sliding-window
+    attention — NOT the full T², so the reported MFU is the conservative
+    (non-flattered) variant.
     """
     D, L, F, V = model.d_model, model.n_layers, model.d_ff, model.vocab
     dkv = (D // model.n_heads) * model.n_kv_heads
     mm_params = L * (2 * D * D + 2 * D * dkv + 2 * D * F)  # qkvo + ffn
     fwd = 2 * (mm_params + D * V)  # + logits head (tied or not, same matmul)
-    attn_fwd = L * 4 * D * (seq_len + 1) / 2  # QK^T + PV, causal average
+    if model.attn_window and model.attn_window < seq_len:
+        W = model.attn_window
+        # Σ_t min(W, t+1) / T: W(W+1)/2 ramp-in keys, then W per token
+        avg_keys = (W * (W + 1) / 2 + (seq_len - W) * W) / seq_len
+    else:
+        avg_keys = (seq_len + 1) / 2  # causal average
+    attn_fwd = L * 4 * D * avg_keys  # QK^T + PV
+    if model.activation == "swiglu":
+        fwd += 2 * L * D * F  # the w3 gate matmul
     return 3.0 * (fwd + attn_fwd)
 
 
@@ -150,11 +159,13 @@ def bench_lm(reps: int, overrides: dict | None = None):
         raise ValueError(f"BENCH_LM_OPT must be adam|adam_compact, "
                          f"got {opt_name!r}")
 
+    window = knob("window", None)  # sliding-window attention (SWA)
     model = TransformerLM(
         vocab=vocab, d_model=d_model, n_heads=n_heads, n_layers=n_layers,
         d_ff=d_ff, max_len=seq, compute_dtype="bfloat16",
         pos_encoding="rotary", tie_embeddings=True,
         n_kv_heads=int(n_kv) if n_kv else None,
+        attn_window=int(window) if window else None,
     )
     optimizer = (adam_compact(1e-3) if opt_name == "adam_compact"
                  else optax.adam(1e-3))
@@ -207,6 +218,7 @@ def bench_lm(reps: int, overrides: dict | None = None):
         "flops_per_token": round(flops_tok),
         "config": f"d{d_model}xL{n_layers}xH{n_heads}"
                   f"{f'kv{n_kv}' if n_kv else ''}xT{seq}xB{batch}"
+                  f"{f'-W{window}' if window else ''}"
                   f"-V{vocab}-bf16-flash-{opt_name}",
     }
 

@@ -1,4 +1,5 @@
 from .adapters import KerasModelAdapter
+from .beam import generate_beam
 from .hf_import import lm_from_hf, load_hf_lm
 from .losses import resolve_accuracy, resolve_per_sample_loss
 from .optimizers import adam_compact, scale_by_adam_compact, to_optax
@@ -52,6 +53,7 @@ __all__ = [
     "quantize_lm_params",
     "quantized_nbytes",
     "KerasModelAdapter",
+    "generate_beam",
     "lm_from_hf",
     "load_hf_lm",
     "resolve_per_sample_loss",

@@ -27,9 +27,10 @@ gpt2      gelu(tanh) + layernorm + attn/ffn biases + learned
           ``[in, out]`` (no transpose)
 llama     swiglu + rmsnorm + rotary (theta, GQA from config);
           ``nn.Linear`` weights transpose from ``[out, in]``
-mistral   llama mapping; ``max_len`` is clamped to the sliding
-          window so full attention is exact over the usable horizon
-qwen2     llama mapping + q/k/v biases (o bias zero-filled)
+mistral   llama mapping + ``attn_window`` = the config's sliding
+          window (real SWA through the flash/decode kernels)
+qwen2     llama mapping + q/k/v biases (o bias zero-filled);
+          ``attn_window`` when ``use_sliding_window``
 ========  ==========================================================
 
 RoPE convention note: this model family and the HF Llama family both use
@@ -107,7 +108,7 @@ def _from_llama_family(cfg, sd, family: str
                        ) -> Tuple[TransformerLM, Dict[str, np.ndarray]]:
     _check(cfg.hidden_act == "silu", f"hidden_act={cfg.hidden_act!r}")
     _check(getattr(cfg, "rope_scaling", None) is None,
-           f"rope_scaling={cfg.rope_scaling!r}")
+           f"rope_scaling={getattr(cfg, 'rope_scaling', None)!r}")
     _check(not getattr(cfg, "mlp_bias", False), "mlp_bias=True")
     L, D = cfg.num_hidden_layers, cfg.hidden_size
     H = cfg.num_attention_heads
@@ -115,13 +116,27 @@ def _from_llama_family(cfg, sd, family: str
            f"head_dim={getattr(cfg, 'head_dim', None)} != d_model/n_heads")
     max_len = cfg.max_position_embeddings
     window = getattr(cfg, "sliding_window", None)
-    windowed = family == "mistral" or (
-        family == "qwen2" and getattr(cfg, "use_sliding_window", False))
-    if windowed and window is not None:
-        # Within the window, full causal attention == sliding-window
-        # attention; clamping the horizon keeps the import exact instead of
-        # silently changing long-range semantics.
-        max_len = min(max_len, window)
+    windowed = family == "mistral" and window is not None
+    if (family == "qwen2" and window is not None
+            and getattr(cfg, "use_sliding_window", False)):
+        # Qwen2 windows only SOME layers (layer_types /
+        # max_window_layers); the global attn_window knob is exact only
+        # when every layer slides — or none does (plain causal import).
+        lt = getattr(cfg, "layer_types", None)
+        if lt is not None:
+            sliding = [t == "sliding_attention" for t in lt]
+        else:
+            mwl = int(getattr(cfg, "max_window_layers", 0) or 0)
+            sliding = [i >= mwl for i in range(cfg.num_hidden_layers)]
+        if all(sliding):
+            windowed = True
+        else:
+            _check(not any(sliding),
+                   "mixed per-layer sliding/full attention "
+                   "(qwen2 layer_types / max_window_layers)")
+    attn_window = window if windowed else None
+    if attn_window is not None and attn_window >= max_len:
+        attn_window = None  # window never binds — plain causal attention
     # qwen2: q/k/v carry biases, o does not — zero-filling bo keeps the
     # math identical under our all-or-nothing attn_bias knob.
     qkv_bias = family == "qwen2" or getattr(cfg, "attention_bias", False)
@@ -133,6 +148,7 @@ def _from_llama_family(cfg, sd, family: str
         n_kv_heads=getattr(cfg, "num_key_value_heads", None) or H,
         tie_embeddings=tie, activation="swiglu", norm="rmsnorm",
         norm_eps=cfg.rms_norm_eps, attn_bias=qkv_bias, ffn_bias=False,
+        attn_window=attn_window,
     )
     pre = "model."
     params: Dict[str, Any] = {

@@ -101,6 +101,16 @@ def build_lm_generate(model: TransformerLM, mesh: Mesh,
                         f"sharded generate shards over {SEQ_AXIS!r}; param "
                         f"{name!r} has spec {spec}"
                     )
+    if model.attn_window is not None:
+        # The per-rank flash-decode partials + lse merge are window-ready
+        # (decode_attention_lse takes a window), but the owner-rank cache
+        # write logic below does not yet skip fully-expired ranks; guard
+        # until that lands rather than silently attending expired keys.
+        raise NotImplementedError(
+            "sequence-sharded generation does not support attn_window yet; "
+            "windowed models decode single-device (generate) where the "
+            "flash-decode kernel skips out-of-window cache blocks"
+        )
     if DATA_AXIS not in mesh.shape or SEQ_AXIS not in mesh.shape:
         raise ValueError(
             f"mesh must carry ({DATA_AXIS!r}, {SEQ_AXIS!r}) axes, got "

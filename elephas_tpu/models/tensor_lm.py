@@ -84,7 +84,8 @@ def _validate_tp(model: TransformerLM, mesh: Mesh) -> int:
             "(build_lm_train_step)"
         )
     if (model.activation, model.norm, model.attn_bias, model.ffn_bias,
-            model.norm_eps) != ("relu", "layernorm", False, True, 1e-5):
+            model.norm_eps, model.attn_window) != (
+            "relu", "layernorm", False, True, 1e-5, None):
         # The TP block math below hardcodes the default architecture; the
         # hf_import families (gelu/swiglu, rmsnorm, biases) generate via
         # models/sharded_generate.py (any-architecture) instead.
@@ -94,7 +95,7 @@ def _validate_tp(model: TransformerLM, mesh: Mesh) -> int:
             "attention); got "
             f"activation={model.activation!r} norm={model.norm!r} "
             f"attn_bias={model.attn_bias} ffn_bias={model.ffn_bias} "
-            f"norm_eps={model.norm_eps}"
+            f"norm_eps={model.norm_eps} attn_window={model.attn_window}"
         )
     if DATA_AXIS not in mesh.shape or TP_AXIS not in mesh.shape:
         raise ValueError(

@@ -224,7 +224,8 @@ class TransformerLM:
                  n_kv_heads: Optional[int] = None, activation: str = "relu",
                  norm: str = "layernorm", norm_eps: float = 1e-5,
                  attn_bias: bool = False, ffn_bias: bool = True,
-                 rope_theta: float = 10000.0):
+                 rope_theta: float = 10000.0,
+                 attn_window: Optional[int] = None):
         if d_model % n_heads:
             raise ValueError(f"d_model {d_model} not divisible by {n_heads} heads")
         n_kv_heads = n_heads if n_kv_heads is None else int(n_kv_heads)
@@ -256,6 +257,13 @@ class TransformerLM:
         self.attn_bias = bool(attn_bias)
         self.ffn_bias = bool(ffn_bias)
         self.rope_theta = float(rope_theta)
+        # Sliding-window attention (Mistral convention): query t sees keys
+        # (t-window, t]. Exact O(T·window) compute on the flash/decode
+        # kernel paths — out-of-window tiles are neither DMA'd nor
+        # computed (ops/pallas_flash.py, ops/flash_decode.py).
+        if attn_window is not None and int(attn_window) < 1:
+            raise ValueError(f"attn_window must be >= 1, got {attn_window}")
+        self.attn_window = None if attn_window is None else int(attn_window)
         self.tie_embeddings = bool(tie_embeddings)
         self.vocab = vocab
         self.d_model = d_model
@@ -338,8 +346,9 @@ class TransformerLM:
         built ONCE per forward in ``apply_with_aux`` — building them here
         would re-materialize them every scanned layer); elsewhere it is
         applied here before the scan."""
+        w = self.attn_window
         if attn == "dense":
-            return attention_reference(q, k, v, causal=True)
+            return attention_reference(q, k, v, causal=True, window=w)
         if attn == "flash":
             # Blockwise exact attention (custom-VJP flash fwd+bwd): no
             # [T, T] materialization in either direction. Single-shard
@@ -347,15 +356,24 @@ class TransformerLM:
             if rope_tables is not None:
                 from ..ops.pallas_flash import flash_attention_rope
 
-                return flash_attention_rope(q, k, v, *rope_tables, True)
+                return flash_attention_rope(q, k, v, *rope_tables, True,
+                                            window=w)
             if rope is not None:
                 q = _rope_rotate(q, *rope)
                 k = _rope_rotate(k, *rope)
-            return flash_attention(q, k, v, causal=True)
-        if attn == "ring":
-            return ring_attention_local(q, k, v, causal=True,
-                                        axis_name=seq_axis)
-        if attn == "ulysses":
+            return flash_attention(q, k, v, causal=True, window=w)
+        if attn in ("ring", "ulysses"):
+            if w is not None:
+                # A window spanning at most one shard boundary could stop
+                # the ring after ceil(window/T_local) hops — not built yet.
+                raise NotImplementedError(
+                    "attn_window is not supported on the ring/ulysses "
+                    "sequence-parallel paths; train windowed models with "
+                    "attn='flash' (sp=1) or shard the batch axis instead"
+                )
+            if attn == "ring":
+                return ring_attention_local(q, k, v, causal=True,
+                                            axis_name=seq_axis)
             return ulysses_attention_local(q, k, v, causal=True,
                                            axis_name=seq_axis)
         raise ValueError(f"Unknown attn: {attn}")
@@ -567,8 +585,10 @@ class TransformerLM:
             # Pallas kernels pad and mask arbitrary prompt lengths
             # internally, so no pre-padding is needed here.
             if not is_tpu_backend():
-                return attention_reference(q, k, v, causal=True)
-            return flash_attention(q, k, v, causal=True)
+                return attention_reference(q, k, v, causal=True,
+                                           window=self.attn_window)
+            return flash_attention(q, k, v, causal=True,
+                                   window=self.attn_window)
 
         def block(h, lp):
             h, _, k, v = self._block_fwd(
@@ -628,7 +648,9 @@ class TransformerLM:
             # training paths broadcast to): flash-decode Pallas kernel on
             # TPU (one VMEM pass over the cache), einsum reference elsewhere
             qg = q.reshape(B, Hkv, H // Hkv, Dh)
-            a = decode_attention(qg, kc, vc, pos).astype(cd).reshape(B, H, Dh)
+            a = decode_attention(
+                qg, kc, vc, pos, window=self.attn_window
+            ).astype(cd).reshape(B, H, Dh)
             h = h + self._attn_proj(lp, "o", a.reshape(B, self.d_model))
             x = self._norm_h(lp, "ln2", h).astype(cd)
             out, _ = self._ffn(lp, x[:, None, :], "dense", SEQ_AXIS,
@@ -669,8 +691,11 @@ class TransformerLM:
         h = self._embed(params, tokens, pos_b)  # [B, S, D]
         rope = self._rope_for(pos_b)
         # [B, S, T] causal-vs-cache mask: row b's query i sees cache
-        # j <= pos0_b + i
+        # j <= pos0_b + i (within the sliding window, if any)
         mask = jnp.arange(T)[None, None, :] <= pos_b[:, :, None]
+        if self.attn_window is not None:
+            mask &= jnp.arange(T)[None, None, :] > (
+                pos_b[:, :, None] - self.attn_window)
 
         def block(h, inputs):
             lp, kc, vc = inputs  # layer params; cache slices [B, Hkv, T, Dh]

@@ -70,7 +70,7 @@ def _pick_block(t: int, block_size: int) -> int:
     return blk
 
 
-def _block_scores(qh, kb, j, blk, t, causal, scale):
+def _block_scores(qh, kb, j, blk, t, causal, scale, window=None):
     """f32 scores of all queries against KV block ``j`` (masked)."""
     scores = jnp.einsum(
         "bhqd,bhkd->bhqk", qh, kb, preferred_element_type=jnp.float32,
@@ -79,6 +79,8 @@ def _block_scores(qh, kb, j, blk, t, causal, scale):
     if causal:
         kpos = j * blk + jnp.arange(blk)
         mask = kpos[None, :] <= jnp.arange(t)[:, None]  # [T, blk]
+        if window is not None:
+            mask &= kpos[None, :] > jnp.arange(t)[:, None] - int(window)
         scores = jnp.where(mask[None, None], scores, -jnp.inf)
     return scores
 
@@ -116,7 +118,7 @@ def _kv_blocks(x, n_blocks, blk):
     )  # [n, B, H, blk, D]
 
 
-def _flash_fwd_scan(qh, kh, vh, causal, blk, scale):
+def _flash_fwd_scan(qh, kh, vh, causal, blk, scale, window=None):
     """Online-softmax forward → ``(out [B,H,T,D] f32, lse [B,H,T] f32)``."""
     b, h, t, d = qh.shape
     n_blocks = t // blk
@@ -126,7 +128,7 @@ def _flash_fwd_scan(qh, kh, vh, causal, blk, scale):
     def fold(carry, inputs):
         m, l, acc = carry
         j, kj, vj = inputs
-        scores = _block_scores(qh, kj, j, blk, t, causal, scale)
+        scores = _block_scores(qh, kj, j, blk, t, causal, scale, window)
         return fold_softmax_block(scores, vj, m, l, acc), None
 
     m0 = jnp.full((b, h, t), -jnp.inf, jnp.float32)
@@ -139,26 +141,27 @@ def _flash_fwd_scan(qh, kh, vh, causal, blk, scale):
     return acc / l[..., None], m + jnp.log(l)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash(q, k, v, causal, block_size):
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, block_size, window):
     out, _ = _flash_fwd_scan(
         _heads_first(q), _heads_first(k), _heads_first(v),
         causal, _pick_block(q.shape[1], block_size), q.shape[-1] ** -0.5,
+        window,
     )
     return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
-def _flash_fwd(q, k, v, causal, block_size):
+def _flash_fwd(q, k, v, causal, block_size, window):
     qh, kh, vh = _heads_first(q), _heads_first(k), _heads_first(v)
     out, lse = _flash_fwd_scan(
         qh, kh, vh, causal, _pick_block(q.shape[1], block_size),
-        q.shape[-1] ** -0.5,
+        q.shape[-1] ** -0.5, window,
     )
     primal = jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
     return primal, (q, k, v, out, lse)
 
 
-def _flash_bwd(causal, block_size, residuals, g):
+def _flash_bwd(causal, block_size, window, residuals, g):
     """Flash backward: recompute each block's probabilities from the saved
     logsumexp; one scan carrying ``dq``, emitting per-block ``dk``/``dv``."""
     q, k, v, out, lse = residuals
@@ -175,7 +178,7 @@ def _flash_bwd(causal, block_size, residuals, g):
 
     def fold(dq, inputs):
         j, kj, vj = inputs
-        scores = _block_scores(qh, kj, j, blk, t, causal, scale)
+        scores = _block_scores(qh, kj, j, blk, t, causal, scale, window)
         p = jnp.exp(scores - lse[..., None])  # exp(-inf)=0 handles masks
         dv_j = jnp.einsum(
             "bhqk,bhqd->bhkd", p, gh, preferred_element_type=jnp.float32,
@@ -215,7 +218,8 @@ def _flash_bwd(causal, block_size, residuals, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, causal: bool = False, block_size: int = 128):
+def flash_attention(q, k, v, causal: bool = False, block_size: int = 128,
+                    window=None):
     """Exact attention via online softmax over KV blocks, ``O(T · block)``
     memory in BOTH directions (see module docstring).
 
@@ -233,10 +237,13 @@ def flash_attention(q, k, v, causal: bool = False, block_size: int = 128):
     """
     from .pallas_ops import is_tpu_backend
 
+    if window is not None and not causal:
+        raise ValueError("window requires causal attention")
     if is_tpu_backend():
         from .pallas_flash import flash_attention_tpu
 
-        return flash_attention_tpu(q, k, v, causal)
+        return flash_attention_tpu(q, k, v, causal, window=window)
     k = repeat_kv_heads(k, q.shape[2])
     v = repeat_kv_heads(v, q.shape[2])
-    return _flash(q, k, v, causal, block_size)
+    return _flash(q, k, v, causal, block_size,
+                  None if window is None else int(window))

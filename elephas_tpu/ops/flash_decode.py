@@ -51,36 +51,39 @@ def aligned_cache_length(length: int) -> int:
 # -- reference (fallback / oracle) implementation ----------------------------
 
 
-def decode_attention_reference(q, k, v, pos):
+def decode_attention_reference(q, k, v, pos, window=None):
     """Grouped decode attention against a cache.
 
     ``q`` [B, Hkv, G, Dh]; ``k``/``v`` [B, Hkv, T, Dh]; ``pos`` scalar int
     or per-row ``[B]`` int (batched speculative decoding advances rows at
-    different positions) — row b sees positions ``0..pos[b]`` inclusive.
-    Returns [B, Hkv, G, Dh] float32, softmax in f32. One body serves this
-    and the lse-exposing variant (same dedup rationale as the Pallas side).
+    different positions) — row b sees positions ``0..pos[b]`` inclusive,
+    restricted to the last ``window`` of them under sliding-window
+    attention. Returns [B, Hkv, G, Dh] float32, softmax in f32. One body
+    serves this and the lse-exposing variant (same dedup rationale as the
+    Pallas side).
     """
-    return decode_attention_reference_lse(q, k, v, pos)[0]
+    return decode_attention_reference_lse(q, k, v, pos, window)[0]
 
 
 # -- pallas kernel ------------------------------------------------------------
 
 
-def flash_decode(q, k, v, pos, interpret: bool = False):
+def flash_decode(q, k, v, pos, interpret: bool = False, window=None):
     """Fused decode attention (Pallas). Same contract as
     :func:`decode_attention_reference`; ``pos`` may be a traced scalar.
 
     One kernel serves both this and :func:`flash_decode_lse` — this entry
     discards the (tiny, lane-broadcast) lse output rather than keeping a
     second copy of the online-softmax kernel in sync."""
-    return flash_decode_lse(q, k, v, pos, interpret=interpret)[0]
+    return flash_decode_lse(q, k, v, pos, interpret=interpret,
+                            window=window)[0]
 
 
-def decode_attention(q, k, v, pos):
+def decode_attention(q, k, v, pos, window=None):
     """Dispatcher: Pallas flash-decode on TPU, jnp reference elsewhere."""
     if is_tpu_backend():
-        return flash_decode(q, k, v, pos)
-    return decode_attention_reference(q, k, v, pos)
+        return flash_decode(q, k, v, pos, window=window)
+    return decode_attention_reference(q, k, v, pos, window)
 
 
 # -- lse-exposing variant (sequence-parallel decode) --------------------------
@@ -94,7 +97,7 @@ def decode_attention(q, k, v, pos):
 # (psum/pmax over the axis — three tiny collectives on [B, Hkv, G] tensors).
 
 
-def decode_attention_reference_lse(q, k, v, pos):
+def decode_attention_reference_lse(q, k, v, pos, window=None):
     """Like :func:`decode_attention_reference` but also returns
     ``lse [B, Hkv, G] f32`` — the log of the softmax denominator (shifted by
     nothing: ``logsumexp`` of the masked scaled scores)."""
@@ -104,7 +107,10 @@ def decode_attention_reference_lse(q, k, v, pos):
         precision=jax.lax.Precision.HIGHEST,
     ) * (dh ** -0.5)
     pos_rows = jnp.asarray(pos).reshape(-1, 1, 1, 1)  # scalar or per-row [B]
-    mask = jnp.arange(k.shape[2])[None, None, None, :] <= pos_rows
+    slots = jnp.arange(k.shape[2])[None, None, None, :]
+    mask = slots <= pos_rows
+    if window is not None:
+        mask &= slots > pos_rows - int(window)
     scores = jnp.where(mask, scores, -jnp.inf)
     m = jnp.max(scores, axis=-1)
     p = jnp.exp(scores - m[..., None])
@@ -116,8 +122,8 @@ def decode_attention_reference_lse(q, k, v, pos):
     return out, m + jnp.log(l)
 
 
-def _decode_kernel_lse(d_true: int, block_t: int, pos_ref, q_ref, k_ref,
-                       v_ref, o_ref, lse_ref, m_s, l_s, acc_s):
+def _decode_kernel_lse(d_true: int, block_t: int, window, pos_ref, q_ref,
+                       k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s):
     """Online-softmax decode kernel with an lse output (lane-broadcast).
 
     ``pos_ref`` is per-row ``[B]`` (scalar callers broadcast): the batch
@@ -135,8 +141,13 @@ def _decode_kernel_lse(d_true: int, block_t: int, pos_ref, q_ref, k_ref,
         acc_s[:] = jnp.zeros_like(acc_s)
 
     start = t * block_t
+    live = start <= pos_ref[b]
+    if window is not None:
+        # blocks wholly below the window contribute nothing
+        live = jnp.logical_and(
+            live, start + block_t - 1 >= pos_ref[b] - (int(window) - 1))
 
-    @pl.when(start <= pos_ref[b])
+    @pl.when(live)
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32)
         k = k_ref[0, 0].astype(jnp.float32)
@@ -147,7 +158,10 @@ def _decode_kernel_lse(d_true: int, block_t: int, pos_ref, q_ref, k_ref,
             precision=jax.lax.Precision.HIGHEST,
         ) * (d_true ** -0.5)
         j = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(j <= pos_ref[b], s, _NEG)
+        keep = j <= pos_ref[b]
+        if window is not None:
+            keep = jnp.logical_and(keep, j > pos_ref[b] - int(window))
+        s = jnp.where(keep, s, _NEG)
         m_prev = m_s[:, :1]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_cur)
@@ -166,7 +180,7 @@ def _decode_kernel_lse(d_true: int, block_t: int, pos_ref, q_ref, k_ref,
         lse_ref[0, 0] = m_s[:] + jnp.log(l_s[:])
 
 
-def flash_decode_lse(q, k, v, pos, interpret: bool = False):
+def flash_decode_lse(q, k, v, pos, interpret: bool = False, window=None):
     """Fused decode attention returning ``(out, lse)``; ``pos`` (scalar or
     per-row ``[B]``) must be ``>= 0`` (a rank with nothing visible clamps
     pos and overrides its lse to −inf outside the kernel — see
@@ -185,20 +199,23 @@ def flash_decode_lse(q, k, v, pos, interpret: bool = False):
     pos_arr = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,))
     n_t = Tp // bt
 
+    if window is None:
+        # blocks past row b's pos are never DMA'd
+        kv_ix = lambda b, h, t, s: (b, h, jnp.minimum(t, s[b] // bt), 0)
+    else:
+        # ...nor, under a sliding window, blocks wholly before it
+        w = int(window)
+        kv_ix = lambda b, h, t, s: (
+            b, h,
+            jnp.clip(t, jnp.maximum((s[b] - w + 1) // bt, 0), s[b] // bt),
+            0)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(B, Hkv, n_t),
         in_specs=[
             pl.BlockSpec((1, 1, Gp, Dh), lambda b, h, t, s: (b, h, 0, 0)),
-            # blocks past row b's pos are never DMA'd
-            pl.BlockSpec(
-                (1, 1, bt, Dh),
-                lambda b, h, t, s: (b, h, jnp.minimum(t, s[b] // bt), 0),
-            ),
-            pl.BlockSpec(
-                (1, 1, bt, Dh),
-                lambda b, h, t, s: (b, h, jnp.minimum(t, s[b] // bt), 0),
-            ),
+            pl.BlockSpec((1, 1, bt, Dh), kv_ix),
+            pl.BlockSpec((1, 1, bt, Dh), kv_ix),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, Gp, Dh), lambda b, h, t, s: (b, h, 0, 0)),
@@ -211,7 +228,7 @@ def flash_decode_lse(q, k, v, pos, interpret: bool = False):
         ],
     )
     out, lse = pl.pallas_call(
-        functools.partial(_decode_kernel_lse, Dh, bt),
+        functools.partial(_decode_kernel_lse, Dh, bt, window),
         out_shape=[
             jax.ShapeDtypeStruct((B, Hkv, Gp, Dh), jnp.float32),
             jax.ShapeDtypeStruct((B, Hkv, Gp, _LANE), jnp.float32),
@@ -222,8 +239,8 @@ def flash_decode_lse(q, k, v, pos, interpret: bool = False):
     return out[:, :, :G, :], lse[:, :, :G, 0]
 
 
-def decode_attention_lse(q, k, v, pos):
+def decode_attention_lse(q, k, v, pos, window=None):
     """Dispatcher for the lse-exposing decode attention."""
     if is_tpu_backend():
-        return flash_decode_lse(q, k, v, pos)
-    return decode_attention_reference_lse(q, k, v, pos)
+        return flash_decode_lse(q, k, v, pos, window=window)
+    return decode_attention_reference_lse(q, k, v, pos, window)

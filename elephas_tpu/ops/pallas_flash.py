@@ -58,15 +58,23 @@ def _prec(*refs):
     return None
 
 
-def _visible(causal: bool, i, j, bq: int, bk: int):
-    """May query tile ``i`` see any of KV tile ``j``? (causal only)"""
+def _visible(causal: bool, i, j, bq: int, bk: int, window=None):
+    """May query tile ``i`` see any of KV tile ``j``? (causal only; with a
+    sliding ``window``, tiles wholly below every query's window are skipped
+    too — the compute saving that makes long-context SWA O(T·window))."""
     if not causal:
         return True
-    return j * bk <= i * bq + bq - 1
+    vis = j * bk <= i * bq + bq - 1
+    if window is not None:
+        vis = jnp.logical_and(
+            vis, j * bk + bk - 1 >= i * bq - (int(window) - 1))
+    return vis
 
 
-def _mask_t(sT, causal: bool, i, j, bq: int, bk: int, t_true: int):
-    """Causal + length masking on a k-major ``[bk, bq]`` score tile.
+def _mask_t(sT, causal: bool, i, j, bq: int, bk: int, t_true: int,
+            window=None):
+    """Causal (+ sliding-window) + length masking on a k-major ``[bk, bq]``
+    score tile.
 
     Length masks apply only when T was padded up to the tile size. Padded
     *query* rows must be masked too (not just sliced off after): backward
@@ -78,6 +86,8 @@ def _mask_t(sT, causal: bool, i, j, bq: int, bk: int, t_true: int):
         kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, sT.shape, 0)
         qpos = i * bq + jax.lax.broadcasted_iota(jnp.int32, sT.shape, 1)
         keep = kpos <= qpos
+        if window is not None:
+            keep &= kpos > qpos - int(window)
     if t_true % bk:
         kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, sT.shape, 0)
         m = kpos < t_true
@@ -111,7 +121,7 @@ def _rot(x, c2, s2, neg: bool = False):
 
 
 def _fwd_kernel(causal: bool, bq: int, bk: int, t_true: int, scale: float,
-                rope: bool, *refs):
+                rope: bool, window, *refs):
     from jax.experimental import pallas as pl
 
     if rope:
@@ -132,7 +142,7 @@ def _fwd_kernel(causal: bool, bq: int, bk: int, t_true: int, scale: float,
             qr_s[:] = _rot(q_ref[0, 0].astype(jnp.float32),
                            cq_ref[0], sq_ref[0])
 
-    @pl.when(_visible(causal, i, j, bq, bk))
+    @pl.when(_visible(causal, i, j, bq, bk, window))
     def _compute():
         if rope:
             q = qr_s[:].astype(q_ref.dtype)
@@ -145,7 +155,7 @@ def _fwd_kernel(causal: bool, bq: int, bk: int, t_true: int, scale: float,
             k, q, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec,
         ) * scale
-        sT = _mask_t(sT, causal, i, j, bq, bk, t_true)
+        sT = _mask_t(sT, causal, i, j, bq, bk, t_true, window)
         m_prev = m_s[:1]                     # [1, bq]
         m_cur = jnp.maximum(m_prev, jnp.max(sT, axis=0, keepdims=True))
         alpha = jnp.exp(m_prev - m_cur)      # [1, bq]
@@ -175,13 +185,43 @@ def _pad_t(a, Tp, T):
     )
 
 
-def _flash_fwd_tpu(q, k, v, causal, bq, bk, interpret, rope=None):
+def _kv_clamp(bq: int, bk: int, window):
+    """KV-tile index clamp for query tile ``i``: invisible tiles (future
+    ones, and — under a sliding window — wholly-expired ones) are never
+    DMA'd; their index maps to the nearest visible tile and ``pl.when``
+    skips the compute."""
+    last = lambda i: (i * bq + bq - 1) // bk
+    if window is None:
+        return lambda i, j: jnp.minimum(j, last(i))
+    first = lambda i: jnp.maximum((i * bq - (int(window) - 1)) // bk, 0)
+    return lambda i, j: jnp.clip(j, first(i), last(i))
+
+
+def _q_clamp(bq: int, bk: int, window):
+    """Query-tile index clamp for KV tile ``j`` (the dkv kernel's inner
+    axis): clamp early (pre-causal) tiles up, and — under a window —
+    too-late tiles down to the last one whose queries still see tile j."""
+    lo = lambda j: (j * bk) // bq
+    if window is None:
+        return lambda j, i: jnp.maximum(i, lo(j))
+    hi = lambda j: ((j + 1) * bk + int(window) - 2) // bq
+    return lambda j, i: jnp.clip(i, lo(j), hi(j))
+
+
+def _flash_fwd_tpu(q, k, v, causal, bq, bk, interpret, rope=None,
+                   window=None):
     """``q`` [B, H, T, Dh]; ``k``/``v`` [B, Hkv, T, Dh] → (o, lse).
 
     ``rope=(c2, s2)`` ([B, T, Dh] f32, the duplicated half-split tables)
     fuses the rotary embedding of q and k into the kernel — the rotated
-    tensors never exist in HBM.
+    tensors never exist in HBM. ``window`` = sliding-window attention
+    (causal only): query ``t`` sees keys ``(t-window, t]``.
     """
+    if window is not None and not causal:
+        # single chokepoint for every public entry (tpu/with_lse/rope):
+        # silently ignoring the window would return full bidirectional
+        # attention for a caller who asked for a sliding one
+        raise ValueError("window requires causal attention")
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -198,13 +238,12 @@ def _flash_fwd_tpu(q, k, v, causal, bq, bk, interpret, rope=None):
     nq, nk = Tq // bq, Tk // bk
     scale = Dh ** -0.5
 
-    # Invisible KV tiles are never DMA'd: clamp their index to the last
-    # visible tile for this query tile (the compute is pl.when-skipped).
+    # Invisible KV tiles are never DMA'd: clamp their index into the
+    # visible range for this query tile (the compute is pl.when-skipped).
     if causal:
-        kv_ix = lambda b, h, i, j: (
-            b, h // G, jnp.minimum(j, (i * bq + bq - 1) // bk), 0)
-        rk_ix = lambda b, h, i, j: (
-            b, jnp.minimum(j, (i * bq + bq - 1) // bk), 0)
+        cl = _kv_clamp(bq, bk, window)
+        kv_ix = lambda b, h, i, j: (b, h // G, cl(i, j), 0)
+        rk_ix = lambda b, h, i, j: (b, cl(i, j), 0)
     else:
         kv_ix = lambda b, h, i, j: (b, h // G, j, 0)
         rk_ix = lambda b, h, i, j: (b, j, 0)
@@ -227,7 +266,7 @@ def _flash_fwd_tpu(q, k, v, causal, bq, bk, interpret, rope=None):
     grid = (B, H, nq, nk)
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, causal, bq, bk, T, scale,
-                          rope is not None),
+                          rope is not None, window),
         grid=grid,
         in_specs=in_specs,
         out_specs=[
@@ -253,7 +292,7 @@ def _flash_fwd_tpu(q, k, v, causal, bq, bk, interpret, rope=None):
 
 
 def _dq_kernel(causal: bool, bq: int, bk: int, t_true: int, scale: float,
-               rope: bool, *refs):
+               rope: bool, window, *refs):
     from jax.experimental import pallas as pl
 
     if rope:
@@ -272,7 +311,7 @@ def _dq_kernel(causal: bool, bq: int, bk: int, t_true: int, scale: float,
             qr_s[:] = _rot(q_ref[0, 0].astype(jnp.float32),
                            cq_ref[0], sq_ref[0])
 
-    @pl.when(_visible(causal, i, j, bq, bk))
+    @pl.when(_visible(causal, i, j, bq, bk, window))
     def _compute():
         if rope:
             q = qr_s[:].astype(q_ref.dtype)
@@ -287,7 +326,7 @@ def _dq_kernel(causal: bool, bq: int, bk: int, t_true: int, scale: float,
             k, q, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec,
         ) * scale                            # [bk, bq]
-        sT = _mask_t(sT, causal, i, j, bq, bk, t_true)
+        sT = _mask_t(sT, causal, i, j, bq, bk, t_true, window)
         pT = jnp.exp(sT - lse_ref[0, 0, :1])                  # [bk, bq]
         dpT = jax.lax.dot_general(            # v @ do^T → [bk, bq]
             v, do, (((1,), (1,)), ((), ())),
@@ -309,7 +348,7 @@ def _dq_kernel(causal: bool, bq: int, bk: int, t_true: int, scale: float,
 
 
 def _dkv_kernel(causal: bool, bq: int, bk: int, t_true: int, scale: float,
-                rope: bool, *refs):
+                rope: bool, window, *refs):
     from jax.experimental import pallas as pl
 
     if rope:
@@ -331,7 +370,7 @@ def _dkv_kernel(causal: bool, bq: int, bk: int, t_true: int, scale: float,
             kr_s[:] = _rot(k_ref[0, 0].astype(jnp.float32),
                            ck_ref[0], sk_ref[0])
 
-    @pl.when(_visible(causal, i, j, bq, bk))
+    @pl.when(_visible(causal, i, j, bq, bk, window))
     def _compute():
         if rope:
             q = _rot(q_ref[0, 0], cq_ref[0], sq_ref[0])
@@ -346,7 +385,7 @@ def _dkv_kernel(causal: bool, bq: int, bk: int, t_true: int, scale: float,
             k, q, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32, precision=prec,
         ) * scale                             # [bk, bq]
-        sT = _mask_t(sT, causal, i, j, bq, bk, t_true)
+        sT = _mask_t(sT, causal, i, j, bq, bk, t_true, window)
         pT = jnp.exp(sT - lse_ref[0, 0, :1])
         pTl = pT.astype(do.dtype)
         dv_s[:] += jax.lax.dot_general(       # p^T @ do → [bk, Dh]
@@ -373,7 +412,7 @@ def _dkv_kernel(causal: bool, bq: int, bk: int, t_true: int, scale: float,
 
 
 def _flash_bwd_tpu(q, k, v, o, lse, do, causal, bq, bk, interpret,
-                   delta_minus=None, rope=None):
+                   delta_minus=None, rope=None, window=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -407,17 +446,17 @@ def _flash_bwd_tpu(q, k, v, o, lse, do, causal, bq, bk, interpret,
     scale = Dh ** -0.5
 
     if causal:
-        kv_ix = lambda b, h, i, j: (
-            b, h // G, jnp.minimum(j, (i * bq + bq - 1) // bk), 0)
-        # In the dkv kernel Q is the inner axis: clamp invisible (early)
-        # q tiles up to the first visible one.
-        q_ix = lambda b, h, j, i: (b, h, jnp.maximum(i, (j * bk) // bq), 0)
-        q_ix_s = lambda b, h, j, i: (b, h, 0, jnp.maximum(i, (j * bk) // bq))
+        kcl = _kv_clamp(bq, bk, window)
+        qcl = _q_clamp(bq, bk, window)
+        kv_ix = lambda b, h, i, j: (b, h // G, kcl(i, j), 0)
+        # In the dkv kernel Q is the inner axis: clamp invisible (early,
+        # and under a window also too-late) q tiles into the visible range.
+        q_ix = lambda b, h, j, i: (b, h, qcl(j, i), 0)
+        q_ix_s = lambda b, h, j, i: (b, h, 0, qcl(j, i))
         # rope-table maps (3-D [B, T, Dh] tables, no head axis)
-        rkq_ix = lambda b, h, i, j: (
-            b, jnp.minimum(j, (i * bq + bq - 1) // bk), 0)
+        rkq_ix = lambda b, h, i, j: (b, kcl(i, j), 0)
         rq_ixq = lambda b, h, i, j: (b, i, 0)
-        rq_ixk = lambda b, h, j, i: (b, jnp.maximum(i, (j * bk) // bq), 0)
+        rq_ixk = lambda b, h, j, i: (b, qcl(j, i), 0)
         rk_ixk = lambda b, h, j, i: (b, j, 0)
     else:
         kv_ix = lambda b, h, i, j: (b, h // G, j, 0)
@@ -445,7 +484,7 @@ def _flash_bwd_tpu(q, k, v, o, lse, do, causal, bq, bk, interpret,
         dq_inputs += [c2, s2, c2, s2]
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal, bq, bk, T, scale,
-                          rope is not None),
+                          rope is not None, window),
         grid=(B, H, nq, nk),
         in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, 1, bq, Dh), lambda b, h, i, j: (b, h, i, 0)),
@@ -473,7 +512,7 @@ def _flash_bwd_tpu(q, k, v, o, lse, do, causal, bq, bk, interpret,
         dkv_inputs += [c2, s2, c2, s2]
     dkh, dvh = pl.pallas_call(
         functools.partial(_dkv_kernel, causal, bq, bk, T, scale,
-                          rope is not None),
+                          rope is not None, window),
         grid=(B, H, nk, nq),
         in_specs=dkv_specs,
         out_specs=[
@@ -502,29 +541,33 @@ def _flash_bwd_tpu(q, k, v, o, lse, do, causal, bq, bk, interpret,
 # -- custom-VJP wrapper (model layout [B, T, H, Dh]) --------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention_tpu(q, k, v, causal: bool = False, block_q: int = _BQ,
-                        block_k: int = _BK, interpret: bool = False):
+                        block_k: int = _BK, interpret: bool = False,
+                        window=None):
     """Fused flash attention: ``q`` [B, T, H, Dh], ``k``/``v`` may carry
     fewer (divisor) KV heads. Exact (online-softmax) attention; returns
-    [B, T, H, Dh] in ``q.dtype``."""
-    out, _ = _fa_fwd(q, k, v, causal, block_q, block_k, interpret)
+    [B, T, H, Dh] in ``q.dtype``. ``window`` = sliding-window attention
+    (requires ``causal``): query ``t`` sees keys ``(t-window, t]``."""
+    out, _ = _fa_fwd(q, k, v, causal, block_q, block_k, interpret, window)
     return out
 
 
 # Thin delegates over the (out, lse) variant below — ONE set of
 # swapaxes/residual/backward wrappers to keep in sync, not two.
-def _fa_fwd(q, k, v, causal, block_q, block_k, interpret):
-    (out, _lse), res = _fal_fwd(q, k, v, causal, block_q, block_k, interpret)
+def _fa_fwd(q, k, v, causal, block_q, block_k, interpret, window=None):
+    (out, _lse), res = _fal_fwd(q, k, v, causal, block_q, block_k, interpret,
+                                window)
     return out, res
 
 
-def _fa_bwd(causal, block_q, block_k, interpret, res, g):
+def _fa_bwd(causal, block_q, block_k, interpret, window, res, g):
     lse8 = res[4]
     zero_lse = jnp.zeros(
         (lse8.shape[0], lse8.shape[3], lse8.shape[1]), jnp.float32
     )  # Δ − 0 = Δ: the plain variant has no lse cotangent
-    return _fal_bwd(causal, block_q, block_k, interpret, res, (g, zero_lse))
+    return _fal_bwd(causal, block_q, block_k, interpret, window, res,
+                    (g, zero_lse))
 
 
 flash_attention_tpu.defvjp(_fa_fwd, _fa_bwd)
@@ -533,10 +576,10 @@ flash_attention_tpu.defvjp(_fa_fwd, _fa_bwd)
 # -- (out, lse) variant: the building block for cross-shard merges ------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention_with_lse(q, k, v, causal: bool = False,
                              block_q: int = _BQ, block_k: int = _BK,
-                             interpret: bool = False):
+                             interpret: bool = False, window=None):
     """Like :func:`flash_attention_tpu` but also returns the per-row
     ``lse = logsumexp(scores)`` as ``[B, T, H]`` float32 — DIFFERENTIABLY.
 
@@ -547,21 +590,23 @@ def flash_attention_with_lse(q, k, v, causal: bool = False,
     ``ds = p∘(dp − Δ)`` becomes ``p∘(dp − (Δ − g_lse))`` — and the same
     kernels run unchanged with ``Δ_eff = Δ − g_lse``.
     """
-    (out, lse), _ = _fal_fwd(q, k, v, causal, block_q, block_k, interpret)
+    (out, lse), _ = _fal_fwd(q, k, v, causal, block_q, block_k, interpret,
+                             window)
     return out, lse
 
 
-def _fal_fwd(q, k, v, causal, block_q, block_k, interpret):
+def _fal_fwd(q, k, v, causal, block_q, block_k, interpret, window=None):
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    o, lse8 = _flash_fwd_tpu(qt, kt, vt, causal, block_q, block_k, interpret)
+    o, lse8 = _flash_fwd_tpu(qt, kt, vt, causal, block_q, block_k, interpret,
+                             window=window)
     lse_out = jnp.transpose(lse8[:, :, 0, :], (0, 2, 1))  # [B, T, H]
     return ((jnp.swapaxes(o, 1, 2), lse_out),
             (qt, kt, vt, o, lse8))
 
 
-def _fal_bwd(causal, block_q, block_k, interpret, res, cts):
+def _fal_bwd(causal, block_q, block_k, interpret, window, res, cts):
     qt, kt, vt, o, lse8 = res
     g, g_lse = cts
     do = jnp.swapaxes(g, 1, 2)
@@ -571,7 +616,7 @@ def _fal_bwd(causal, block_q, block_k, interpret, res, cts):
     ).astype(jnp.float32)
     dq, dk, dv = _flash_bwd_tpu(qt, kt, vt, o, lse8, do, causal,
                                 block_q, block_k, interpret,
-                                delta_minus=g_lse8)
+                                delta_minus=g_lse8, window=window)
     return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
             jnp.swapaxes(dv, 1, 2))
 
@@ -592,10 +637,10 @@ def make_rope_tables(cos, sin):
 # -- rope-fused variant (train-path attention with in-kernel rotation) --------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
 def flash_attention_rope(q, k, v, c2, s2, causal: bool = True,
                          block_q: int = _BQ, block_k: int = _BK,
-                         interpret: bool = False):
+                         interpret: bool = False, window=None):
     """Flash attention with the rotary embedding FUSED into the kernels.
 
     ``q`` [B, T, H, Dh] and ``k``/``v`` [B, T, Hkv, Dh] arrive UNROTATED;
@@ -611,36 +656,38 @@ def flash_attention_rope(q, k, v, c2, s2, causal: bool = True,
     frequency gradients through this op.
     """
     (out, _), _res = _far_fwd(q, k, v, c2, s2, causal, block_q, block_k,
-                              interpret)
+                              interpret, window)
     return out
 
 
-def _far_fwd(q, k, v, c2, s2, causal, block_q, block_k, interpret):
+def _far_fwd(q, k, v, c2, s2, causal, block_q, block_k, interpret,
+             window=None):
     c2 = jax.lax.stop_gradient(c2)
     s2 = jax.lax.stop_gradient(s2)
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     o, lse = _flash_fwd_tpu(qt, kt, vt, causal, block_q, block_k, interpret,
-                            rope=(c2, s2))
+                            rope=(c2, s2), window=window)
     return ((jnp.swapaxes(o, 1, 2), lse),
             (qt, kt, vt, o, lse, c2, s2))
 
 
-def _far_bwd(causal, block_q, block_k, interpret, res, g):
+def _far_bwd(causal, block_q, block_k, interpret, window, res, g):
     qt, kt, vt, o, lse, c2, s2 = res
     do = jnp.swapaxes(g, 1, 2)
     dq, dk, dv = _flash_bwd_tpu(qt, kt, vt, o, lse, do, causal,
                                 block_q, block_k, interpret,
-                                rope=(c2, s2))
+                                rope=(c2, s2), window=window)
     # positions are constants: zero cotangent for the tables (DCE'd)
     return (jnp.swapaxes(dq, 1, 2), jnp.swapaxes(dk, 1, 2),
             jnp.swapaxes(dv, 1, 2), jnp.zeros_like(c2), jnp.zeros_like(s2))
 
 
-def _far_fwd_vjp(q, k, v, c2, s2, causal, block_q, block_k, interpret):
+def _far_fwd_vjp(q, k, v, c2, s2, causal, block_q, block_k, interpret,
+                 window=None):
     (out, _lse), res = _far_fwd(q, k, v, c2, s2, causal, block_q, block_k,
-                                interpret)
+                                interpret, window)
     return out, res
 
 

@@ -33,7 +33,7 @@ from ..parallel.mesh import DATA_AXIS
 from .flash_attention import fold_softmax_block, repeat_kv_heads
 
 
-def attention_reference(q, k, v, causal: bool = False):
+def attention_reference(q, k, v, causal: bool = False, window=None):
     """Plain full attention — the single-device test oracle (the Ulysses
     local body uses blockwise ``flash_attention`` instead, avoiding this
     function's ``[T, T]`` score matrix).
@@ -44,7 +44,13 @@ def attention_reference(q, k, v, causal: bool = False):
     float32 even for bf16 inputs — summing a long sequence's normalizer in
     an 8-bit mantissa loses exactly the precision flash/ring practice warns
     about, so every attention path in the package shares the f32 rule.
+
+    ``window`` (requires ``causal``): sliding-window attention — query
+    ``t`` sees keys ``(t-window, t]``, i.e. the last ``window`` positions
+    including itself (the Mistral convention).
     """
+    if window is not None and not causal:
+        raise ValueError("window requires causal attention")
     k = repeat_kv_heads(k, q.shape[2])
     v = repeat_kv_heads(v, q.shape[2])
     scale = q.shape[-1] ** -0.5
@@ -55,6 +61,9 @@ def attention_reference(q, k, v, causal: bool = False):
     if causal:
         tq, tk = scores.shape[-2], scores.shape[-1]
         mask = jnp.arange(tk)[None, :] <= jnp.arange(tq)[:, None]
+        if window is not None:
+            mask &= jnp.arange(tk)[None, :] > (
+                jnp.arange(tq)[:, None] - int(window))
         scores = jnp.where(mask, scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(

@@ -1,0 +1,134 @@
+"""Beam search vs greedy and vs an exhaustive oracle.
+
+On tiny vocabularies the exact best fixed-length continuation can be found
+by brute force — beam search with a wide enough beam must find it, and
+``beam_size=1`` must reproduce greedy ``generate`` token-for-token.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from elephas_tpu.models import TransformerLM, generate_beam
+
+
+def _model(**kw):
+    cfg = dict(vocab=12, d_model=16, n_heads=4, n_layers=2, d_ff=32,
+               max_len=24)
+    cfg.update(kw)
+    return TransformerLM(**cfg)
+
+
+def _params(model, seed=0):
+    return jax.tree.map(jnp.asarray, model.init(seed))
+
+
+def _seq_logprob(model, params, rows, t0):
+    """Summed next-token log-prob of the generated span of ``rows``."""
+    toks = rows[:, :-1]
+    pos = np.broadcast_to(np.arange(toks.shape[1]), toks.shape)
+    lp = jax.nn.log_softmax(
+        np.asarray(model.apply(params, toks, pos)).astype(np.float32), -1)
+    out = []
+    for b in range(rows.shape[0]):
+        s = sum(lp[b, j, rows[b, j + 1]] for j in range(t0 - 1,
+                                                        rows.shape[1] - 1))
+        out.append(float(s))
+    return np.array(out)
+
+
+def test_beam1_equals_greedy():
+    model = _model()
+    params = _params(model)
+    prompt = np.array([[1, 2, 3, 4], [5, 6, 7, 8]], np.int32)
+    want = np.asarray(model.generate(params, prompt, 8))
+    got, scores = generate_beam(model, params, prompt, 8, beam_size=1)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    np.testing.assert_allclose(
+        np.asarray(scores), _seq_logprob(model, params, want, 4), atol=1e-3)
+
+
+def test_wide_beam_finds_exhaustive_optimum():
+    # beam_size = vocab with n_new = 2 IS exhaustive: after the first step
+    # every token is a beam, and the second step ranks all V^2 candidates
+    model = _model(vocab=6, max_len=10)
+    params = _params(model, seed=3)
+    prompt = np.array([[1, 2, 3]], np.int32)
+    n_new = 2
+    best_s, best_rows = -np.inf, None
+    for cont in itertools.product(range(6), repeat=n_new):
+        rows = np.concatenate([prompt, np.array([cont], np.int32)], axis=1)
+        s = _seq_logprob(model, params, rows, 3)[0]
+        if s > best_s:
+            best_s, best_rows = s, rows
+    got, scores = generate_beam(model, params, prompt, n_new, beam_size=6)
+    np.testing.assert_array_equal(np.asarray(got), best_rows)
+    np.testing.assert_allclose(float(scores[0]), best_s, atol=1e-3)
+
+
+def test_beam_score_at_least_greedy():
+    model = _model()
+    params = _params(model, seed=1)
+    prompt = np.array([[3, 1, 4, 1, 5], [9, 2, 6, 5, 3]], np.int32)
+    greedy = np.asarray(model.generate(params, prompt, 9))
+    g_score = _seq_logprob(model, params, greedy, 5)
+    _, b_score = generate_beam(model, params, prompt, 9, beam_size=4)
+    assert (np.asarray(b_score) >= g_score - 1e-4).all()
+
+
+def test_eos_freezes_beams():
+    model = _model()
+    params = _params(model, seed=2)
+    prompt = np.array([[1, 2, 3, 4]], np.int32)
+    eos = 7
+    got, _ = generate_beam(model, params, prompt, 12, beam_size=4,
+                           eos_id=eos)
+    row = np.asarray(got)[0, 4:]
+    hits = np.nonzero(row == eos)[0]
+    if hits.size:  # everything after the first eos must stay eos
+        assert (row[hits[0]:] == eos).all()
+
+
+def test_batch_rows_are_independent():
+    model = _model()
+    params = _params(model, seed=4)
+    p1 = np.array([[1, 2, 3, 4]], np.int32)
+    p2 = np.array([[8, 9, 10, 11]], np.int32)
+    both = np.concatenate([p1, p2], axis=0)
+    g_both, s_both = generate_beam(model, params, both, 6, beam_size=3)
+    g1, s1 = generate_beam(model, params, p1, 6, beam_size=3)
+    g2, s2 = generate_beam(model, params, p2, 6, beam_size=3)
+    np.testing.assert_array_equal(np.asarray(g_both),
+                                  np.concatenate([g1, g2], axis=0))
+    np.testing.assert_allclose(np.asarray(s_both),
+                               np.concatenate([s1, s2]), atol=1e-4)
+
+
+def test_works_on_architecture_variants():
+    for kw in (dict(activation="gelu", attn_bias=True, tie_embeddings=True),
+               dict(activation="swiglu", norm="rmsnorm", ffn_bias=False,
+                    pos_encoding="rotary", n_kv_heads=2, attn_window=5)):
+        model = _model(**kw)
+        params = _params(model)
+        prompt = np.array([[1, 2, 3]], np.int32)
+        want = np.asarray(model.generate(params, prompt, 6))
+        got, _ = generate_beam(model, params, prompt, 6, beam_size=1)
+        np.testing.assert_array_equal(np.asarray(got), want)
+        wide, _ = generate_beam(model, params, prompt, 6, beam_size=4)
+        assert np.asarray(wide).shape == (1, 9)
+
+
+def test_validation():
+    model = _model()
+    params = _params(model)
+    prompt = np.array([[1, 2]], np.int32)
+    with pytest.raises(ValueError, match="beam_size"):
+        generate_beam(model, params, prompt, 4, beam_size=0)
+    with pytest.raises(ValueError, match="vocab"):
+        generate_beam(model, params, prompt, 4, beam_size=200)
+    with pytest.raises(ValueError, match="max_len"):
+        generate_beam(model, params, prompt, 400)

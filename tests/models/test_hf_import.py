@@ -161,3 +161,70 @@ def test_rope_scaling_rejected(tokens):
     hf.config.rope_scaling = {"rope_type": "linear", "factor": 2.0}
     with pytest.raises(NotImplementedError, match="rope_scaling"):
         lm_from_hf(hf)
+
+
+def _tiny_mistral(**over):
+    torch.manual_seed(7)
+    kw = dict(
+        vocab_size=97, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, sliding_window=8, attention_dropout=0.0,
+        attn_implementation="eager",
+    )
+    kw.update(over)
+    m = transformers.MistralForCausalLM(transformers.MistralConfig(**kw))
+    m.eval()
+    return m
+
+
+def test_mistral_sliding_window_logits_parity():
+    # the window BINDS here (T=24 > window=8): parity vs torch's own
+    # sliding-window mask validates the whole SWA stack independently
+    hf = _tiny_mistral()
+    model, params = lm_from_hf(hf)
+    assert model.attn_window == 8 and model.max_len == 64
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 97, size=(2, 24)).astype(np.int32)
+    _assert_logits_close(model, params, hf, toks)
+
+
+def test_mistral_non_binding_window_drops_knob():
+    hf = _tiny_mistral(sliding_window=64)  # >= max_len: never binds
+    model, _ = lm_from_hf(hf)
+    assert model.attn_window is None
+
+
+def test_mistral_greedy_generation_parity(tokens):
+    hf = _tiny_mistral()
+    model, params = lm_from_hf(hf)
+    _assert_greedy_parity(model, params, hf, tokens)
+
+
+def test_qwen2_mixed_sliding_layers_rejected():
+    torch.manual_seed(7)
+    cfg = transformers.Qwen2Config(
+        vocab_size=97, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, use_sliding_window=True,
+        sliding_window=8, max_window_layers=1, attention_dropout=0.0,
+        attn_implementation="eager",
+    )
+    hf = transformers.Qwen2ForCausalLM(cfg)
+    hf.eval()
+    with pytest.raises(NotImplementedError, match="sliding"):
+        lm_from_hf(hf)
+
+
+def test_qwen2_default_no_sliding_imports_full_attention(tokens):
+    torch.manual_seed(7)
+    cfg = transformers.Qwen2Config(
+        vocab_size=97, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, attention_dropout=0.0,
+        attn_implementation="eager",
+    )
+    hf = transformers.Qwen2ForCausalLM(cfg)
+    hf.eval()
+    model, params = lm_from_hf(hf)
+    assert model.attn_window is None and model.attn_bias  # q/k/v biases
+    _assert_logits_close(model, params, hf, tokens)
