@@ -546,7 +546,8 @@ class TransformerLM:
         return _summed_xent(logits, targets)
 
     # -- autoregressive inference (KV cache) ----------------------------
-    def init_cache(self, batch: int, length: Optional[int] = None) -> Dict[str, Any]:
+    def init_cache(self, batch: int, length: Optional[int] = None,
+                   chunk: int = 1) -> Dict[str, Any]:
         """Zeroed KV cache ``{"k"/"v": [L, B, Hkv, T, Dh]}`` where ``T`` is
         ``length`` (default ``max_len``) rounded up to the flash-decode
         T-block, so the kernel never pads (a pad would recopy the cache in
@@ -555,9 +556,27 @@ class TransformerLM:
         over the whole cache. T rides the sublane axis so the kernel streams
         contiguous ``[BT, Dh]`` tiles per (batch, kv-head). Under
         grouped-query attention the cache holds only the KV heads: memory
-        scales down by ``n_heads / n_kv_heads``."""
+        scales down by ``n_heads / n_kv_heads``.
+
+        Sliding-window models get a ROLLING buffer instead: ``T`` is the
+        window (not the horizon — memory stays O(window) however long the
+        rollout), position ``p`` writes slot ``p mod T``, and the decode
+        paths mask by slot AGE. ``chunk`` is the largest block
+        :meth:`decode_chunk` will write per call (``spec_k + 1`` for
+        speculative decoding): the buffer carries ``chunk − 1`` extra slots
+        so a chunk's writes never clobber or alias positions its own
+        earlier queries still attend (see :meth:`decode_chunk`)."""
         L = self.n_layers
-        T = aligned_cache_length(self.max_len if length is None else length)
+        T_req = self.max_len if length is None else length
+        if self.attn_window is not None:
+            # window-clamped buffers carry `chunk` extra slots (not
+            # chunk-1): the buffer is then strictly LARGER than the
+            # window, which is also what lets decode_chunk statically
+            # tell a clamped ring (T > window: wrap possible, margin
+            # required) from a horizon-bounded one (T <= window: the
+            # whole rollout fits, nothing ever wraps)
+            T_req = min(T_req, self.attn_window) + int(chunk)
+        T = aligned_cache_length(T_req)
         shape = (L, batch, self.n_kv_heads, T, self.d_model // self.n_heads)
         z = jnp.zeros(shape, self.compute_dtype)
         return {"k": z, "v": z}
@@ -601,10 +620,25 @@ class TransformerLM:
         h, (ks, vs) = jax.lax.scan(block, h, lps)  # ks/vs [L, B, T0, Hkv, Dh]
         ks = ks.transpose(0, 1, 3, 2, 4)  # → cache layout [L, B, Hkv, T0, Dh]
         vs = vs.transpose(0, 1, 3, 2, 4)
-        cache = {
-            "k": jax.lax.dynamic_update_slice_in_dim(cache["k"], ks, 0, axis=3),
-            "v": jax.lax.dynamic_update_slice_in_dim(cache["v"], vs, 0, axis=3),
-        }
+        Tc = cache["k"].shape[3]
+        if self.attn_window is not None and T0 > Tc:
+            # rolling buffer smaller than the prompt: keep only its last Tc
+            # positions (the earlier ones are outside every future query's
+            # window), scattered to their p mod Tc slots (a rotation)
+            slots = (np.arange(T0 - Tc, T0) % Tc).astype(np.int32)
+            cache = {
+                "k": cache["k"].at[:, :, :, slots].set(ks[:, :, :, T0 - Tc:]),
+                "v": cache["v"].at[:, :, :, slots].set(vs[:, :, :, T0 - Tc:]),
+            }
+        else:
+            # T0 <= Tc: slot p mod Tc == p — the ring write IS the
+            # contiguous slice update (no scatter cost on the common path)
+            cache = {
+                "k": jax.lax.dynamic_update_slice_in_dim(
+                    cache["k"], ks, 0, axis=3),
+                "v": jax.lax.dynamic_update_slice_in_dim(
+                    cache["v"], vs, 0, axis=3),
+            }
         h = self._norm_h(params, "lnf", h)
         return self._logits(params, h), cache
 
@@ -641,15 +675,17 @@ class TransformerLM:
                 # cache stores PRE-ROTATED keys (prefill does the same)
                 q = _rope_rotate(q, r_cos, r_sin)
                 k_new = _rope_rotate(k_new, r_cos[:, None], r_sin[:, None])
-            kc = _cache_update_rows(kc, k_new, pos, per_row)
-            vc = _cache_update_rows(vc, v_new, pos, per_row)
+            ring = self.attn_window is not None
+            widx = jnp.mod(pos, kc.shape[2]) if ring else pos
+            kc = _cache_update_rows(kc, k_new, widx, per_row)
+            vc = _cache_update_rows(vc, v_new, widx, per_row)
             # grouped attention straight against the Hkv-head cache (query
             # head h = kv_head·G + g, matching the repeat layout the
             # training paths broadcast to): flash-decode Pallas kernel on
             # TPU (one VMEM pass over the cache), einsum reference elsewhere
             qg = q.reshape(B, Hkv, H // Hkv, Dh)
             a = decode_attention(
-                qg, kc, vc, pos, window=self.attn_window
+                qg, kc, vc, pos, window=self.attn_window, ring=ring
             ).astype(cd).reshape(B, H, Dh)
             h = h + self._attn_proj(lp, "o", a.reshape(B, self.d_model))
             x = self._norm_h(lp, "ln2", h).astype(cd)
@@ -677,7 +713,14 @@ class TransformerLM:
         :meth:`generate_speculative`'s invariant). ``pos0`` may be traced,
         and may be per-row ``[B]`` (batched speculative verification).
         Like :meth:`decode_step`, the MoE variant routes the chunk as its
-        own dispatch group."""
+        own dispatch group.
+
+        Windowed models use the rolling cache (slot ``p mod T``, age
+        masking): the cache MUST have been allocated with
+        ``init_cache(..., chunk >= S)`` — the chunk margin is what keeps a
+        chunk's later writes from aliasing slots its earlier queries still
+        attend (ages of in-chunk future slots then always exceed the
+        window)."""
         B, S = tokens.shape
         H = self.n_heads
         Hkv = self.n_kv_heads
@@ -690,12 +733,36 @@ class TransformerLM:
             jnp.arange(S)[None, :]  # [B, S] absolute positions per row
         h = self._embed(params, tokens, pos_b)  # [B, S, D]
         rope = self._rope_for(pos_b)
-        # [B, S, T] causal-vs-cache mask: row b's query i sees cache
-        # j <= pos0_b + i (within the sliding window, if any)
-        mask = jnp.arange(T)[None, None, :] <= pos_b[:, :, None]
-        if self.attn_window is not None:
-            mask &= jnp.arange(T)[None, None, :] > (
-                pos_b[:, :, None] - self.attn_window)
+        ring = self.attn_window is not None
+        if ring and S > 1 and \
+                self.attn_window < T < self.attn_window + S - 1:
+            # a window-clamped buffer without enough chunk margin would let
+            # a query attend slots its own chunk writes LATER (silently
+            # wrong logits); horizon-bounded buffers (T <= window) and
+            # margined ones (T >= window+S-1) are both fine
+            raise ValueError(
+                f"ring cache ({T} slots, window {self.attn_window}) cannot "
+                f"take {S}-token chunks; allocate with "
+                f"init_cache(..., chunk={S}) or larger"
+            )
+        slots = jnp.arange(T)[None, None, :]
+        if ring:
+            # rolling cache: [B, S, T] age mask (see flash_decode's ring
+            # contract) — covers warm-up, expiry, and in-chunk causality
+            # given the init_cache chunk margin
+            age = jnp.mod(pos_b[:, :, None] - slots, T)
+            mask = age < jnp.minimum(self.attn_window, pos_b[:, :, None] + 1)
+            slot_b = jnp.mod(pos_b, T)  # [B, S] write slots
+        else:
+            # [B, S, T] causal-vs-cache mask: row b's query i sees cache
+            # j <= pos0_b + i
+            mask = slots <= pos_b[:, :, None]
+
+        def _write_ring(c, new):
+            # c [B, Hkv, T, Dh]; new [B, Hkv, S, Dh] scattered per row
+            return jax.vmap(
+                lambda cb, nb, ib: cb.at[:, ib].set(nb)
+            )(c, new, slot_b)
 
         def block(h, inputs):
             lp, kc, vc = inputs  # layer params; cache slices [B, Hkv, T, Dh]
@@ -706,10 +773,14 @@ class TransformerLM:
             if rope is not None:
                 q = _rope_rotate(q, *rope)
                 k_new = _rope_rotate(k_new, *rope)
-            kc = _cache_update_rows(
-                kc, k_new.transpose(0, 2, 1, 3), pos0, per_row)
-            vc = _cache_update_rows(
-                vc, v_new.transpose(0, 2, 1, 3), pos0, per_row)
+            if ring:
+                kc = _write_ring(kc, k_new.transpose(0, 2, 1, 3))
+                vc = _write_ring(vc, v_new.transpose(0, 2, 1, 3))
+            else:
+                kc = _cache_update_rows(
+                    kc, k_new.transpose(0, 2, 1, 3), pos0, per_row)
+                vc = _cache_update_rows(
+                    vc, v_new.transpose(0, 2, 1, 3), pos0, per_row)
             # grouped attention against the Hkv-head cache, all S queries
             # at once (S is small — the dense [S, T] score block is cheap
             # and hits the MXU as a matrix-matrix product)
@@ -816,10 +887,16 @@ class TransformerLM:
             )
 
         horizon = total + spec_k + 1
-        t_logits, t_cache = self.prefill(params, prompt,
-                                         self.init_cache(1, horizon))
-        _, d_cache = draft.prefill(draft_params, prompt,
-                                   draft.init_cache(1, horizon))
+        t_logits, t_cache = self.prefill(
+            params, prompt,
+            self.init_cache(1, horizon, chunk=spec_k + 1))
+        # chunk margin for the DRAFT too: after a rejection its decode
+        # resumes up to spec_k+1 positions behind its last write, and the
+        # ring age mask (unlike the causal slot<=pos mask) would otherwise
+        # see those stale future slots
+        _, d_cache = draft.prefill(
+            draft_params, prompt,
+            draft.init_cache(1, horizon, chunk=spec_k + 1))
         rng = np.random.default_rng(seed)
 
         def choose(logits_row):
@@ -924,10 +1001,12 @@ class TransformerLM:
         B, T0 = prompt.shape
         total = T0 + n_new
         horizon = total + spec_k + 1
-        t_logits, t_cache = self.prefill(params, prompt,
-                                         self.init_cache(B, horizon))
-        _, d_cache = draft.prefill(draft_params, prompt,
-                                   draft.init_cache(B, horizon))
+        t_logits, t_cache = self.prefill(
+            params, prompt,
+            self.init_cache(B, horizon, chunk=spec_k + 1))
+        _, d_cache = draft.prefill(
+            draft_params, prompt,
+            draft.init_cache(B, horizon, chunk=spec_k + 1))
         rngs = [np.random.default_rng([seed, b]) for b in range(B)]
 
         out = [list(np.asarray(prompt[b])) for b in range(B)]

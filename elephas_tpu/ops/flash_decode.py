@@ -51,7 +51,8 @@ def aligned_cache_length(length: int) -> int:
 # -- reference (fallback / oracle) implementation ----------------------------
 
 
-def decode_attention_reference(q, k, v, pos, window=None):
+def decode_attention_reference(q, k, v, pos, window=None,
+                               ring: bool = False):
     """Grouped decode attention against a cache.
 
     ``q`` [B, Hkv, G, Dh]; ``k``/``v`` [B, Hkv, T, Dh]; ``pos`` scalar int
@@ -62,13 +63,14 @@ def decode_attention_reference(q, k, v, pos, window=None):
     serves this and the lse-exposing variant (same dedup rationale as the
     Pallas side).
     """
-    return decode_attention_reference_lse(q, k, v, pos, window)[0]
+    return decode_attention_reference_lse(q, k, v, pos, window, ring)[0]
 
 
 # -- pallas kernel ------------------------------------------------------------
 
 
-def flash_decode(q, k, v, pos, interpret: bool = False, window=None):
+def flash_decode(q, k, v, pos, interpret: bool = False, window=None,
+                 ring: bool = False):
     """Fused decode attention (Pallas). Same contract as
     :func:`decode_attention_reference`; ``pos`` may be a traced scalar.
 
@@ -76,14 +78,14 @@ def flash_decode(q, k, v, pos, interpret: bool = False, window=None):
     discards the (tiny, lane-broadcast) lse output rather than keeping a
     second copy of the online-softmax kernel in sync."""
     return flash_decode_lse(q, k, v, pos, interpret=interpret,
-                            window=window)[0]
+                            window=window, ring=ring)[0]
 
 
-def decode_attention(q, k, v, pos, window=None):
+def decode_attention(q, k, v, pos, window=None, ring: bool = False):
     """Dispatcher: Pallas flash-decode on TPU, jnp reference elsewhere."""
     if is_tpu_backend():
-        return flash_decode(q, k, v, pos, window=window)
-    return decode_attention_reference(q, k, v, pos, window)
+        return flash_decode(q, k, v, pos, window=window, ring=ring)
+    return decode_attention_reference(q, k, v, pos, window, ring)
 
 
 # -- lse-exposing variant (sequence-parallel decode) --------------------------
@@ -97,10 +99,19 @@ def decode_attention(q, k, v, pos, window=None):
 # (psum/pmax over the axis — three tiny collectives on [B, Hkv, G] tensors).
 
 
-def decode_attention_reference_lse(q, k, v, pos, window=None):
+def decode_attention_reference_lse(q, k, v, pos, window=None,
+                                   ring: bool = False):
     """Like :func:`decode_attention_reference` but also returns
     ``lse [B, Hkv, G] f32`` — the log of the softmax denominator (shifted by
-    nothing: ``logsumexp`` of the masked scaled scores)."""
+    nothing: ``logsumexp`` of the masked scaled scores).
+
+    ``ring=True`` (requires ``window``): the cache is a ROLLING buffer of
+    ``Tc`` slots — slot ``s`` holds absolute position ``pos - ((pos - s)
+    mod Tc)`` (writes land at ``p mod Tc``). A slot is visible iff its age
+    ``(pos - s) mod Tc`` is ``< min(window, pos+1)`` — one formula that
+    covers warm-up (ages past ``pos`` wrap high and mask out) and steady
+    state (expired slots age out), for scalar and per-row positions alike.
+    """
     dh = q.shape[-1]
     scores = jnp.einsum(
         "bkgd,bktd->bkgt", q, k, preferred_element_type=jnp.float32,
@@ -108,9 +119,15 @@ def decode_attention_reference_lse(q, k, v, pos, window=None):
     ) * (dh ** -0.5)
     pos_rows = jnp.asarray(pos).reshape(-1, 1, 1, 1)  # scalar or per-row [B]
     slots = jnp.arange(k.shape[2])[None, None, None, :]
-    mask = slots <= pos_rows
-    if window is not None:
-        mask &= slots > pos_rows - int(window)
+    if ring:
+        if window is None:
+            raise ValueError("ring cache attention requires a window")
+        age = jnp.mod(pos_rows - slots, k.shape[2])
+        mask = age < jnp.minimum(int(window), pos_rows + 1)
+    else:
+        mask = slots <= pos_rows
+        if window is not None:
+            mask &= slots > pos_rows - int(window)
     scores = jnp.where(mask, scores, -jnp.inf)
     m = jnp.max(scores, axis=-1)
     p = jnp.exp(scores - m[..., None])
@@ -122,8 +139,9 @@ def decode_attention_reference_lse(q, k, v, pos, window=None):
     return out, m + jnp.log(l)
 
 
-def _decode_kernel_lse(d_true: int, block_t: int, window, pos_ref, q_ref,
-                       k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s):
+def _decode_kernel_lse(d_true: int, block_t: int, window, t_ring, pos_ref,
+                       q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s,
+                       acc_s):
     """Online-softmax decode kernel with an lse output (lane-broadcast).
 
     ``pos_ref`` is per-row ``[B]`` (scalar callers broadcast): the batch
@@ -141,11 +159,15 @@ def _decode_kernel_lse(d_true: int, block_t: int, window, pos_ref, q_ref,
         acc_s[:] = jnp.zeros_like(acc_s)
 
     start = t * block_t
-    live = start <= pos_ref[b]
-    if window is not None:
-        # blocks wholly below the window contribute nothing
-        live = jnp.logical_and(
-            live, start + block_t - 1 >= pos_ref[b] - (int(window) - 1))
+    if t_ring is not None:
+        # rolling cache: the whole (window-sized) buffer is live
+        live = True
+    else:
+        live = start <= pos_ref[b]
+        if window is not None:
+            # blocks wholly below the window contribute nothing
+            live = jnp.logical_and(
+                live, start + block_t - 1 >= pos_ref[b] - (int(window) - 1))
 
     @pl.when(live)
     def _compute():
@@ -158,9 +180,15 @@ def _decode_kernel_lse(d_true: int, block_t: int, window, pos_ref, q_ref,
             precision=jax.lax.Precision.HIGHEST,
         ) * (d_true ** -0.5)
         j = start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        keep = j <= pos_ref[b]
-        if window is not None:
-            keep = jnp.logical_and(keep, j > pos_ref[b] - int(window))
+        if t_ring is not None:
+            # slot age under the rolling buffer (see the reference impl)
+            age = jnp.mod(pos_ref[b] - j, t_ring)
+            keep = age < jnp.minimum(int(window), pos_ref[b] + 1)
+            keep = jnp.logical_and(keep, j < t_ring)  # alignment padding
+        else:
+            keep = j <= pos_ref[b]
+            if window is not None:
+                keep = jnp.logical_and(keep, j > pos_ref[b] - int(window))
         s = jnp.where(keep, s, _NEG)
         m_prev = m_s[:, :1]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -180,7 +208,8 @@ def _decode_kernel_lse(d_true: int, block_t: int, window, pos_ref, q_ref,
         lse_ref[0, 0] = m_s[:] + jnp.log(l_s[:])
 
 
-def flash_decode_lse(q, k, v, pos, interpret: bool = False, window=None):
+def flash_decode_lse(q, k, v, pos, interpret: bool = False, window=None,
+                     ring: bool = False):
     """Fused decode attention returning ``(out, lse)``; ``pos`` (scalar or
     per-row ``[B]``) must be ``>= 0`` (a rank with nothing visible clamps
     pos and overrides its lse to −inf outside the kernel — see
@@ -199,7 +228,12 @@ def flash_decode_lse(q, k, v, pos, interpret: bool = False, window=None):
     pos_arr = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,))
     n_t = Tp // bt
 
-    if window is None:
+    if ring:
+        if window is None:
+            raise ValueError("ring cache attention requires a window")
+        # the buffer IS the window: every block is live, nothing to skip
+        kv_ix = lambda b, h, t, s: (b, h, t, 0)
+    elif window is None:
         # blocks past row b's pos are never DMA'd
         kv_ix = lambda b, h, t, s: (b, h, jnp.minimum(t, s[b] // bt), 0)
     else:
@@ -228,7 +262,8 @@ def flash_decode_lse(q, k, v, pos, interpret: bool = False, window=None):
         ],
     )
     out, lse = pl.pallas_call(
-        functools.partial(_decode_kernel_lse, Dh, bt, window),
+        functools.partial(_decode_kernel_lse, Dh, bt, window,
+                          T if ring else None),
         out_shape=[
             jax.ShapeDtypeStruct((B, Hkv, Gp, Dh), jnp.float32),
             jax.ShapeDtypeStruct((B, Hkv, Gp, _LANE), jnp.float32),
@@ -239,8 +274,8 @@ def flash_decode_lse(q, k, v, pos, interpret: bool = False, window=None):
     return out[:, :, :G, :], lse[:, :, :G, 0]
 
 
-def decode_attention_lse(q, k, v, pos, window=None):
+def decode_attention_lse(q, k, v, pos, window=None, ring: bool = False):
     """Dispatcher for the lse-exposing decode attention."""
     if is_tpu_backend():
-        return flash_decode_lse(q, k, v, pos, window=window)
-    return decode_attention_reference_lse(q, k, v, pos, window)
+        return flash_decode_lse(q, k, v, pos, window=window, ring=ring)
+    return decode_attention_reference_lse(q, k, v, pos, window, ring)

@@ -187,3 +187,57 @@ def test_window_guards():
         step(params, opt_init(params), *batch)
     with pytest.raises(ValueError, match="attn_window"):
         _model(**{**MISTRALISH, "attn_window": 0})
+
+
+def test_ring_cache_memory_is_o_window():
+    model = _model(**{**MISTRALISH, "max_len": 512})
+    c = model.init_cache(2, 500)
+    assert c["k"].shape[3] <= 2 * MISTRALISH["attn_window"] + 8
+    # chunk margin grows the buffer, not the horizon
+    c2 = model.init_cache(2, 500, chunk=5)
+    assert c2["k"].shape[3] <= MISTRALISH["attn_window"] + 4 + 8
+
+
+def test_ring_cache_long_rollout_matches_teacher_forced():
+    model = _model(**{**MISTRALISH, "max_len": 128})
+    params = jax.tree.map(jnp.asarray, model.init(0))
+    prompt = _rows(b=2, t=9, vocab=31)[:, :9].astype(np.int32)
+    out = np.asarray(model.generate(params, prompt, 40))
+    for j in range(9, 49):
+        pos = np.broadcast_to(np.arange(j), (2, j))
+        lg = np.asarray(model.apply(params, out[:, :j], pos))[:, -1]
+        np.testing.assert_array_equal(out[:, j], lg.argmax(-1))
+
+
+def test_ring_cache_long_prompt_prefill():
+    # prompt longer than the ring buffer: only its window-tail is kept
+    model = _model(**{**MISTRALISH, "max_len": 128})
+    params = jax.tree.map(jnp.asarray, model.init(0))
+    prompt = _rows(b=2, t=30, vocab=31)[:, :30].astype(np.int32)
+    out = np.asarray(model.generate(params, prompt, 12))
+    for j in range(30, 42):
+        pos = np.broadcast_to(np.arange(j), (2, j))
+        lg = np.asarray(model.apply(params, out[:, :j], pos))[:, -1]
+        np.testing.assert_array_equal(out[:, j], lg.argmax(-1))
+
+
+def test_ring_cache_speculative_equals_rollout():
+    model = _model(**{**MISTRALISH, "max_len": 128})
+    draft = _model(**{**MISTRALISH, "max_len": 128, "d_ff": 16})
+    params = jax.tree.map(jnp.asarray, model.init(0))
+    dparams = jax.tree.map(jnp.asarray, draft.init(1))
+    prompt = _rows(b=2, t=8, vocab=31)[:, :8].astype(np.int32)
+    want = np.asarray(model.generate(params, prompt, 30))
+    got = np.asarray(model.generate_speculative(params, prompt, 30, draft,
+                                                dparams, spec_k=4))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_ring_chunk_margin_guard():
+    model = _model(**{**MISTRALISH, "max_len": 128})
+    params = jax.tree.map(jnp.asarray, model.init(0))
+    prompt = _rows(b=1, t=4, vocab=31)[:, :4].astype(np.int32)
+    cache = model.init_cache(1, 64)  # no chunk margin
+    _, cache = model.prefill(params, jnp.asarray(prompt), cache)
+    with pytest.raises(ValueError, match="chunk"):
+        model.decode_chunk(params, jnp.asarray(prompt), 4, cache)

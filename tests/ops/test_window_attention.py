@@ -146,3 +146,30 @@ def test_decode_window_equals_full_when_not_binding():
     full, _ = decode_attention_reference_lse(qd, kc, vc, 5)
     win, _ = decode_attention_reference_lse(qd, kc, vc, 5, window=100)
     np.testing.assert_allclose(win, full, rtol=1e-7, atol=1e-7)
+
+
+@pytest.mark.parametrize("pos", [3, 11, 40, np.array([5, 57])])
+def test_flash_decode_ring_matches_reference(pos):
+    # rolling buffer: 16 slots, window 11 — positions far beyond the
+    # buffer wrap; kernel must agree with the age-masked reference
+    rng = np.random.default_rng(3)
+    kc = jnp.asarray(rng.normal(size=(B, 2, 16, Dh)), jnp.float32)
+    vc = jnp.asarray(rng.normal(size=(B, 2, 16, Dh)), jnp.float32)
+    qd = jnp.asarray(rng.normal(size=(B, 2, 2, Dh)), jnp.float32)
+    window = 11
+    want, want_lse = decode_attention_reference_lse(qd, kc, vc, pos, window,
+                                                    ring=True)
+    got, got_lse = flash_decode_lse(qd, kc, vc, pos, interpret=True,
+                                    window=window, ring=True)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(got_lse, want_lse, rtol=1e-6, atol=1e-6)
+
+
+def test_ring_requires_window():
+    rng = np.random.default_rng(4)
+    kc = jnp.asarray(rng.normal(size=(B, 2, 16, Dh)), jnp.float32)
+    qd = jnp.asarray(rng.normal(size=(B, 2, 2, Dh)), jnp.float32)
+    with pytest.raises(ValueError, match="window"):
+        decode_attention_reference_lse(qd, kc, kc, 3, ring=True)
+    with pytest.raises(ValueError, match="window"):
+        flash_decode_lse(qd, kc, kc, 3, interpret=True, ring=True)
