@@ -5,12 +5,13 @@ EXTENSION BEYOND THE REFERENCE. The reference consumes Keras models only
 JSON/weights); it has no interop with foreign checkpoint formats. This
 module gives the TPU framework a migration path for the dominant public
 checkpoint ecosystem: a ``transformers`` causal LM (GPT-2-, Llama-,
-Mistral- or Qwen2-family) converts into the functional
-:class:`TransformerLM` param dict, after which EVERYTHING in this
-framework applies unchanged — Pallas flash attention/decode kernels,
-int8 quantization (``models/quantize.py``), LoRA fine-tuning
-(``models/lora.py``), speculative decoding, and sharded dp×sp generation
-(``models/sharded_generate.py``).
+Mistral-, Qwen2- or Mixtral-family) converts into the functional
+:class:`TransformerLM` / :class:`MoETransformerLM` param dict, after
+which EVERYTHING in this framework applies unchanged — Pallas flash
+attention/decode kernels, int8 quantization (``models/quantize.py``),
+LoRA fine-tuning (``models/lora.py``), speculative decoding, sharded
+dp×sp generation (``models/sharded_generate.py``), and expert-sharded
+MoE serving.
 
 The conversion is exact, not approximate: ``tests/models/test_hf_import.py``
 pins logits parity against the torch forward pass (CPU torch is the
@@ -31,6 +32,9 @@ mistral   llama mapping + ``attn_window`` = the config's sliding
           window (real SWA through the flash/decode kernels)
 qwen2     llama mapping + q/k/v biases (o bias zero-filled);
           ``attn_window`` when ``use_sliding_window``
+mixtral   llama attention + sparse-MoE FFN → ``MoETransformerLM``
+          (swiglu experts, top-k renormalized routing; capacity
+          pinned to never bind so routing equals HF's exactly)
 ========  ==========================================================
 
 RoPE convention note: this model family and the HF Llama family both use
@@ -184,6 +188,76 @@ def _from_llama_family(cfg, sd, family: str
     return model, params
 
 
+def _from_mixtral(cfg, sd) -> Tuple[TransformerLM, Dict[str, np.ndarray]]:
+    """Mixtral-family sparse-MoE checkpoints → :class:`MoETransformerLM`.
+
+    Routing parity note: HF Mixtral softmaxes the router logits, takes the
+    top-k probabilities, and renormalizes them — algebraically identical
+    to this framework's ``token_choice`` combine weights *when capacity
+    never binds*, so the import pins ``capacity_factor = E/k`` (a slot for
+    every token; no drops). Serving deployments can lower it afterward —
+    that is then GShard-style capacity-bounded Mixtral, a documented
+    approximation, not the checkpoint's exact math.
+    """
+    from .transformer import MoETransformerLM
+
+    _check(cfg.hidden_act == "silu", f"hidden_act={cfg.hidden_act!r}")
+    _check(getattr(cfg, "rope_scaling", None) is None,
+           f"rope_scaling={getattr(cfg, 'rope_scaling', None)!r}")
+    L, D = cfg.num_hidden_layers, cfg.hidden_size
+    H = cfg.num_attention_heads
+    _check(getattr(cfg, "head_dim", None) in (None, D // H),
+           f"head_dim={getattr(cfg, 'head_dim', None)} != d_model/n_heads")
+    E = cfg.num_local_experts
+    k = cfg.num_experts_per_tok
+    max_len = cfg.max_position_embeddings
+    window = getattr(cfg, "sliding_window", None)
+    if window is not None and window >= max_len:
+        window = None
+    model = MoETransformerLM(
+        vocab=cfg.vocab_size, d_model=D, n_heads=H, n_layers=L,
+        d_ff=cfg.intermediate_size, max_len=max_len,
+        n_experts=E, k=k, capacity_factor=E / k,
+        aux_weight=getattr(cfg, "router_aux_loss_coef", 0.0),
+        pos_encoding="rotary", rope_theta=getattr(cfg, "rope_theta", 1e6),
+        n_kv_heads=getattr(cfg, "num_key_value_heads", None) or H,
+        tie_embeddings=bool(getattr(cfg, "tie_word_embeddings", False)),
+        activation="swiglu", norm="rmsnorm", norm_eps=cfg.rms_norm_eps,
+        attn_bias=False, ffn_bias=False, attn_window=window,
+    )
+    pre = "model."
+    params: Dict[str, Any] = {
+        "tok": _np(sd[pre + "embed_tokens.weight"]),
+        "lnf_s": _np(sd[pre + "norm.weight"]),
+    }
+    if not model.tie_embeddings:
+        params["head"] = np.ascontiguousarray(_np(sd["lm_head.weight"]).T)
+
+    def stack(fmt, transpose=False):
+        mats = [_np(sd[pre + fmt.format(i)]) for i in range(L)]
+        if transpose:
+            mats = [m.T for m in mats]
+        return np.ascontiguousarray(np.stack(mats))
+
+    def estack(fmt):  # [L, E, in, out] from per-expert [out, in] Linears
+        return np.ascontiguousarray(np.stack([
+            np.stack([_np(sd[pre + fmt.format(i, e)]).T for e in range(E)])
+            for i in range(L)
+        ]))
+
+    params["ln1_s"] = stack("layers.{}.input_layernorm.weight")
+    params["ln2_s"] = stack("layers.{}.post_attention_layernorm.weight")
+    params["wq"] = stack("layers.{}.self_attn.q_proj.weight", True)
+    params["wk"] = stack("layers.{}.self_attn.k_proj.weight", True)
+    params["wv"] = stack("layers.{}.self_attn.v_proj.weight", True)
+    params["wo"] = stack("layers.{}.self_attn.o_proj.weight", True)
+    params["wg"] = stack("layers.{}.block_sparse_moe.gate.weight", True)
+    params["w1"] = estack("layers.{}.block_sparse_moe.experts.{}.w1.weight")
+    params["w3"] = estack("layers.{}.block_sparse_moe.experts.{}.w3.weight")
+    params["w2"] = estack("layers.{}.block_sparse_moe.experts.{}.w2.weight")
+    return model, params
+
+
 def lm_from_hf(hf_model, compute_dtype: str = "float32"
                ) -> Tuple[TransformerLM, Dict[str, np.ndarray]]:
     """Convert a loaded ``transformers`` causal LM → ``(model, params)``.
@@ -200,9 +274,11 @@ def lm_from_hf(hf_model, compute_dtype: str = "float32"
         model, params = _from_gpt2(cfg, sd)
     elif family in ("llama", "mistral", "qwen2"):
         model, params = _from_llama_family(cfg, sd, family)
+    elif family == "mixtral":
+        model, params = _from_mixtral(cfg, sd)
     else:
         raise NotImplementedError(
-            f"hf_import supports gpt2/llama/mistral/qwen2, got "
+            f"hf_import supports gpt2/llama/mistral/qwen2/mixtral, got "
             f"model_type={family!r}"
         )
     model.compute_dtype = jnp.dtype(compute_dtype)

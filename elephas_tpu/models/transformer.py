@@ -1173,12 +1173,23 @@ class MoETransformerLM(TransformerLM):
                  ep_groups: int = 1, compute_dtype: str = "float32",
                  routing: str = "token_choice", pos_encoding: str = "learned",
                  tie_embeddings: bool = False,
-                 n_kv_heads: Optional[int] = None):
+                 n_kv_heads: Optional[int] = None, activation: str = "relu",
+                 norm: str = "layernorm", norm_eps: float = 1e-5,
+                 attn_bias: bool = False, ffn_bias: bool = True,
+                 rope_theta: float = 10000.0,
+                 attn_window: Optional[int] = None):
+        # ``activation``/``ffn_bias`` configure the EXPERTS (the MoE block
+        # replaces the dense FFN); the remaining knobs hit the attention/
+        # norm stack via the base class — together they cover the
+        # Mixtral-family shape (swiglu experts, rmsnorm, rotary, GQA).
         super().__init__(vocab, d_model, n_heads, n_layers, d_ff, max_len,
                          compute_dtype=compute_dtype,
                          pos_encoding=pos_encoding,
                          tie_embeddings=tie_embeddings,
-                         n_kv_heads=n_kv_heads)
+                         n_kv_heads=n_kv_heads, activation=activation,
+                         norm=norm, norm_eps=norm_eps, attn_bias=attn_bias,
+                         ffn_bias=ffn_bias, rope_theta=rope_theta,
+                         attn_window=attn_window)
         from ..parallel.expert import MoEFeedForward
 
         if routing == "expert_choice":
@@ -1194,7 +1205,8 @@ class MoETransformerLM(TransformerLM):
             )
         self.moe = MoEFeedForward(d_model, d_ff, n_experts, k=k,
                                   capacity_factor=capacity_factor,
-                                  routing=routing)
+                                  routing=routing, activation=activation,
+                                  bias=ffn_bias)
         self.n_experts = n_experts
         self.aux_weight = aux_weight
         self.ep_groups = int(ep_groups)
@@ -1203,26 +1215,29 @@ class MoETransformerLM(TransformerLM):
         shapes = super().param_shapes()
         L = self.n_layers
         # replace the dense FFN stacks with per-layer expert stacks
-        for k_ in ("w1", "b1", "w2", "b2"):
-            del shapes[k_]
+        for k_ in ("w1", "b1", "w2", "b2", "w3"):
+            shapes.pop(k_, None)
         for k_, sds in self.moe.param_shapes().items():
             shapes[k_] = jax.ShapeDtypeStruct((L,) + sds.shape, sds.dtype)
         return shapes
 
     def specs(self) -> Dict[str, P]:
         specs = {k: P() for k in self.param_shapes()}
-        for k_ in ("w1", "b1", "w2", "b2"):
+        for k_ in self.moe.expert_keys():
             specs[k_] = P(None, SEQ_AXIS)  # [L, E, ...]: E over "seq"
         return specs
 
     def _block_keys(self):
-        return ("ln1_s", "ln1_b", "wq", "wk", "wv", "wo",
-                "ln2_s", "ln2_b", "wg", "w1", "b1", "w2", "b2")
+        base = [k for k in super()._block_keys()
+                if k not in ("w1", "b1", "w2", "b2", "w3")]
+        return tuple(base) + ("wg",) + self.moe.expert_keys()
 
     def _ffn(self, lp, x, attn: str, seq_axis: str,
              ep_groups: Optional[int] = None):
         B, T = x.shape[0], x.shape[1]
-        moe_params = {k_: lp[k_] for k_ in ("wg", "w1", "b1", "w2", "b2")}
+        moe_params = {
+            k_: lp[k_] for k_ in ("wg",) + self.moe.expert_keys()
+        }
         if attn != "dense":
             flat = x.reshape(B * T, self.d_model)
             y, aux = self.moe.apply(moe_params, flat, axis_name=seq_axis)
@@ -1235,6 +1250,7 @@ class MoETransformerLM(TransformerLM):
         G = self.ep_groups if ep_groups is None else ep_groups
         if T % G:
             raise ValueError(f"T={T} not divisible by ep_groups={G}")
+        # (moe_params collected above)
         tl = T // G
         D = self.d_model
         xg = x.reshape(B, G, tl, D).transpose(1, 0, 2, 3).reshape(G * B * tl, D)

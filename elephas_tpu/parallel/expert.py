@@ -115,7 +115,10 @@ def _expert_choice_dispatch(gates, capacity: int):
 
 
 class MoEFeedForward:
-    """Top-k routed expert FFN (``D → F → D`` per expert, relu).
+    """Top-k routed expert FFN (``D → F → D`` per expert; relu by
+    default, or swiglu/gelu via ``activation`` with optional biases —
+    the Mixtral-family expert shape is ``activation="swiglu",
+    bias=False``).
 
     ``capacity_factor`` sizes each expert's buffer PER SOURCE SHARD as
     ``ceil(cf · k · N_shard / E)`` (``N_shard`` = that shard's token count),
@@ -128,29 +131,39 @@ class MoEFeedForward:
 
     def __init__(self, d_model: int, d_ff: int, n_experts: int, k: int = 2,
                  capacity_factor: float = 1.25,
-                 routing: str = "token_choice"):
+                 routing: str = "token_choice", activation: str = "relu",
+                 bias: bool = True):
         if n_experts < k:
             raise ValueError(f"need n_experts >= k, got {n_experts} < {k}")
         if routing not in ("token_choice", "expert_choice"):
             raise ValueError(f"Unknown routing: {routing}")
+        if activation not in ("relu", "gelu", "swiglu"):
+            raise ValueError(f"Unknown activation: {activation}")
         self.d_model = d_model
         self.d_ff = d_ff
         self.n_experts = n_experts
         self.k = k
         self.capacity_factor = capacity_factor
         self.routing = routing
+        self.activation = activation
+        self.bias = bool(bias)
 
     def param_shapes(self) -> Dict[str, jax.ShapeDtypeStruct]:
         """Full (unsharded) shape/dtype per param — the shape-only source for
         :meth:`init` and the train-step builder's optimizer-state specs."""
         E, D, F = self.n_experts, self.d_model, self.d_ff
-        return {
+        shapes = {
             "wg": jax.ShapeDtypeStruct((D, E), jnp.float32),
             "w1": jax.ShapeDtypeStruct((E, D, F), jnp.float32),
             "b1": jax.ShapeDtypeStruct((E, F), jnp.float32),
             "w2": jax.ShapeDtypeStruct((E, F, D), jnp.float32),
             "b2": jax.ShapeDtypeStruct((E, D), jnp.float32),
         }
+        if self.activation == "swiglu":
+            shapes["w3"] = jax.ShapeDtypeStruct((E, D, F), jnp.float32)
+        if not self.bias:
+            del shapes["b1"], shapes["b2"]
+        return shapes
 
     def init(self, seed: int = 0) -> Dict[str, np.ndarray]:
         rng = np.random.default_rng(seed)
@@ -160,12 +173,15 @@ class MoEFeedForward:
             for name, sds in self.param_shapes().items()
         }
 
+    def expert_keys(self):
+        """The per-expert stacked param names (everything except the
+        replicated router) — what shards over the expert axis."""
+        return tuple(k for k in self.param_shapes() if k != "wg")
+
     def specs(self) -> Dict[str, P]:
-        return {
-            "wg": P(),
-            "w1": P(EXPERT_AXIS), "b1": P(EXPERT_AXIS),
-            "w2": P(EXPERT_AXIS), "b2": P(EXPERT_AXIS),
-        }
+        out = {"wg": P()}
+        out.update({k: P(EXPERT_AXIS) for k in self.expert_keys()})
+        return out
 
     def shard_params(self, mesh: Mesh, params: Dict[str, Any]) -> Dict[str, Any]:
         return shard_by_specs(mesh, self.specs(), params)
@@ -186,11 +202,31 @@ class MoEFeedForward:
                              / self.n_experts))
         )
 
-    @staticmethod
-    def _expert_ffn(w1, b1, w2, b2, x):
-        """One expert's FFN over its ``[C, D]`` block (vmapped over E)."""
-        h = jax.nn.relu(jnp.dot(x, w1) + b1)
-        return jnp.dot(h, w2) + b2
+    def _expert_ffn(self, *args):
+        """One expert's FFN over its ``[C, D]`` block (vmapped over E).
+        Argument order matches :meth:`_expert_args`."""
+        if self.activation == "swiglu":
+            if self.bias:
+                w1, w2, w3, b1, b2, x = args
+                h = jax.nn.silu(jnp.dot(x, w1) + b1) * jnp.dot(x, w3)
+                return jnp.dot(h, w2) + b2
+            w1, w2, w3, x = args
+            h = jax.nn.silu(jnp.dot(x, w1)) * jnp.dot(x, w3)
+            return jnp.dot(h, w2)
+        act = jax.nn.relu if self.activation == "relu" else             (lambda u: jax.nn.gelu(u, approximate=True))
+        if self.bias:
+            w1, w2, b1, b2, x = args
+            return jnp.dot(act(jnp.dot(x, w1) + b1), w2) + b2
+        w1, w2, x = args
+        return jnp.dot(act(jnp.dot(x, w1)), w2)
+
+    def _expert_args(self, params):
+        """Expert stacks in the positional order ``_expert_ffn`` takes
+        (weights first, then biases — matching ``expert_keys`` sorted
+        w-before-b)."""
+        ws = [params[k] for k in self.expert_keys() if k.startswith("w")]
+        bs = [params[k] for k in self.expert_keys() if k.startswith("b")]
+        return ws + bs
 
     def apply(self, params: Dict[str, Any], x, axis_name: str = EXPERT_AXIS):
         """Forward INSIDE shard_map. ``x``: local tokens ``[N_l, D]``;
@@ -218,9 +254,7 @@ class MoEFeedForward:
         blocks = jax.lax.all_to_all(
             blocks, axis_name, split_axis=0, concat_axis=1, tiled=True
         )
-        out = jax.vmap(self._expert_ffn)(
-            params["w1"], params["b1"], params["w2"], params["b2"], blocks
-        )
+        out = jax.vmap(self._expert_ffn)(*self._expert_args(params), blocks)
         # transpose re-shard: [E/P, P·C, D] → [E, C, D]
         out = jax.lax.all_to_all(
             out, axis_name, split_axis=1, concat_axis=0, tiled=True
@@ -267,9 +301,10 @@ class MoEFeedForward:
                 w = jnp.sum(combine, axis=-1)  # [Nb, E] kept combine weights
                 c1s.append(c1)
                 gsums.append(gsum)
+            args = self._expert_args(params)
             out_all = jax.vmap(
-                self._expert_ffn, in_axes=(0, 0, 0, 0, None)
-            )(params["w1"], params["b1"], params["w2"], params["b2"], blk)
+                self._expert_ffn, in_axes=(0,) * len(args) + (None,)
+            )(*args, blk)
             ys.append(jnp.einsum("ne,end->nd", w, out_all))
         if self.routing == "expert_choice":
             return jnp.concatenate(ys, axis=0), jnp.asarray(0.0, jnp.float32)
@@ -306,7 +341,7 @@ def build_ep_train_step(model: MoEFeedForward, mesh: Mesh, optimizer,
     pspecs = model.specs()
     sspecs = opt_state_specs(optimizer, model.param_shapes(), pspecs)
     token_spec = P((DATA_AXIS, EXPERT_AXIS))
-    expert_keys = ("w1", "b1", "w2", "b2")
+    expert_keys = model.expert_keys()
     dp = mesh.shape[DATA_AXIS]
     ep = mesh.shape[EXPERT_AXIS]
 

@@ -43,8 +43,14 @@ def tiny_hf_models():
         vocab_size=128, hidden_size=64, intermediate_size=96,
         num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
         max_position_embeddings=64, attention_dropout=0.0))
-    gpt2.eval(), llama.eval()
-    return {"gpt2": gpt2, "llama-gqa": llama}
+    mixtral = transformers.MixtralForCausalLM(transformers.MixtralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=96,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, num_local_experts=4,
+        num_experts_per_tok=2, attention_dropout=0.0, sliding_window=None,
+        attn_implementation="eager"))
+    gpt2.eval(), llama.eval(), mixtral.eval()
+    return {"gpt2": gpt2, "llama-gqa": llama, "mixtral-moe": mixtral}
 
 
 def main():
