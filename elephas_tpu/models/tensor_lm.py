@@ -58,8 +58,8 @@ from ..parallel.param_utils import make_opt_init, opt_state_specs, \
     shard_by_specs
 from .transformer import (
     TransformerLM,
-    _layer_norm,
     _rope_angles,
+    write_prompt_cache,
     _rope_rotate,
     _summed_xent,
     select_tokens,
@@ -82,20 +82,6 @@ def _validate_tp(model: TransformerLM, mesh: Mesh) -> int:
             "tensor parallelism covers the dense TransformerLM family; the "
             "MoE variant shards its experts over the seq axis instead "
             "(build_lm_train_step)"
-        )
-    if (model.activation, model.norm, model.attn_bias, model.ffn_bias,
-            model.norm_eps, model.attn_window) != (
-            "relu", "layernorm", False, True, 1e-5, None):
-        # The TP block math below hardcodes the default architecture; the
-        # hf_import families (gelu/swiglu, rmsnorm, biases) generate via
-        # models/sharded_generate.py (any-architecture) instead.
-        raise NotImplementedError(
-            "tensor parallelism currently covers the default architecture "
-            "(relu + layernorm(eps 1e-5) + ffn biases + bias-free "
-            "attention); got "
-            f"activation={model.activation!r} norm={model.norm!r} "
-            f"attn_bias={model.attn_bias} ffn_bias={model.ffn_bias} "
-            f"norm_eps={model.norm_eps} attn_window={model.attn_window}"
         )
     if DATA_AXIS not in mesh.shape or TP_AXIS not in mesh.shape:
         raise ValueError(
@@ -123,9 +109,20 @@ def tp_specs(model: TransformerLM) -> Dict[str, P]:
         "wv": P(None, None, TP_AXIS),
         "wo": P(None, TP_AXIS, None),
         "w1": P(None, None, TP_AXIS),
-        "b1": P(None, TP_AXIS),
         "w2": P(None, TP_AXIS, None),
     })
+    # architecture-conditional stacks (hf_import families): the swiglu
+    # gate is column-sharded like w1; q/k/v biases shard with their
+    # columns' heads; o/ffn output biases stay replicated — they add
+    # AFTER the psum (adding a sharded copy before it would scale by tp)
+    if model.ffn_bias:
+        specs["b1"] = P(None, TP_AXIS)
+    if model.activation == "swiglu":
+        specs["w3"] = P(None, None, TP_AXIS)
+    if model.attn_bias:
+        specs["bq"] = P(None, TP_AXIS)
+        specs["bk"] = P(None, TP_AXIS)
+        specs["bv"] = P(None, TP_AXIS)
     return specs
 
 
@@ -159,14 +156,13 @@ def _tp_block(model: TransformerLM, h, lp, rope, attend, grad_mode: bool,
         enter = lambda x: x
         tp_sum = lambda x: jax.lax.psum(x, TP_AXIS)
 
-    x = _layer_norm(h.astype(jnp.float32), lp["ln1_s"],
-                    lp["ln1_b"]).astype(cd)
+    x = model._norm_h(lp, "ln1", h).astype(cd)
     x_in = enter(x)
     hl = lp["wq"].shape[-1] // Dh  # local query heads
-    q = (x_in @ lp["wq"].astype(cd)).reshape(B, T, hl, Dh)
+    q = model._attn_proj(lp, "q", x_in).reshape(B, T, hl, Dh)
     kvl = lp["wk"].shape[-1] // Dh  # local KV heads
-    k = (x_in @ lp["wk"].astype(cd)).reshape(B, T, kvl, Dh)
-    v = (x_in @ lp["wv"].astype(cd)).reshape(B, T, kvl, Dh)
+    k = model._attn_proj(lp, "k", x_in).reshape(B, T, kvl, Dh)
+    v = model._attn_proj(lp, "v", x_in).reshape(B, T, kvl, Dh)
     if rope is not None and not fused_rope:
         # fused_rope: the attend closure rotates q/k inside the Pallas
         # kernel from once-built tables (training path; the returned k is
@@ -176,14 +172,32 @@ def _tp_block(model: TransformerLM, h, lp, rope, attend, grad_mode: bool,
     a = attend(q, k, v).astype(cd)
     part = a.reshape(B, T, hl * Dh) @ lp["wo"].astype(cd)
     h = h + tp_sum(part)
+    if model.attn_bias:  # replicated o-bias adds once, post-psum
+        h = h + lp["bo"].astype(cd)
 
-    x = _layer_norm(h.astype(jnp.float32), lp["ln2_s"],
-                    lp["ln2_b"]).astype(cd)
+    x = model._norm_h(lp, "ln2", h).astype(cd)
     x_in = enter(x)
-    u = jax.nn.relu(x_in @ lp["w1"].astype(cd) + lp["b1"].astype(cd))
-    part = u @ lp["w2"].astype(cd)
-    out = tp_sum(part) + lp["b2"].astype(cd)
+    out = _tp_ffn(model, lp, x_in, cd, tp_sum)
     return h + out.astype(cd), (k, v)
+
+
+def _tp_ffn(model: TransformerLM, lp, x_in, cd, tp_sum):
+    """The FFN half of a TP block on column/row shards: ``w1``(+``w3``)
+    column-sharded (their bias shards ride along), ``w2`` row-sharded,
+    ONE psum, replicated ``b2`` added after it."""
+    u = x_in @ lp["w1"].astype(cd)
+    if model.ffn_bias:
+        u = u + lp["b1"].astype(cd)
+    if model.activation == "swiglu":
+        u = jax.nn.silu(u) * (x_in @ lp["w3"].astype(cd))
+    elif model.activation == "gelu":
+        u = jax.nn.gelu(u, approximate=True)
+    else:
+        u = jax.nn.relu(u)
+    out = tp_sum(u @ lp["w2"].astype(cd))
+    if model.ffn_bias:
+        out = out + lp["b2"].astype(cd)
+    return out
 
 
 def _tp_forward(model: TransformerLM, params, tokens, positions, attn: str,
@@ -204,13 +218,14 @@ def _tp_forward(model: TransformerLM, params, tokens, positions, attn: str,
         tables = make_rope_tables(cos[..., 0, :], sin[..., 0, :])
 
     def attend(q, k, v):
+        w = model.attn_window
         if tables is not None:
             from ..ops.pallas_flash import flash_attention_rope
 
-            return flash_attention_rope(q, k, v, *tables, True)
+            return flash_attention_rope(q, k, v, *tables, True, window=w)
         if on_tpu_flash:
-            return flash_attention(q, k, v, causal=True)
-        return attention_reference(q, k, v, causal=True)
+            return flash_attention(q, k, v, causal=True, window=w)
+        return attention_reference(q, k, v, causal=True, window=w)
 
     def block(h, lp):
         h, kv = _tp_block(model, h, lp, rope, attend, grad_mode,
@@ -219,8 +234,7 @@ def _tp_forward(model: TransformerLM, params, tokens, positions, attn: str,
 
     lps = {k: params[k] for k in model._block_keys()}
     h, (ks, vs) = jax.lax.scan(block, h, lps)
-    h = _layer_norm(h.astype(jnp.float32), params["lnf_s"],
-                    params["lnf_b"])
+    h = model._norm_h(params, "lnf", h)
     return model._logits(params, h), (ks, vs)
 
 
@@ -310,13 +324,14 @@ def build_lm_tp_generate(model: TransformerLM, mesh: Mesh,
         positions = jnp.broadcast_to(jnp.arange(T0), (B, T0))
         logits, (ks, vs) = _tp_forward(model, params, prompt, positions,
                                        attn, grad_mode=False)
-        # ks/vs [L, B, T0, kvl, Dh] → cache layout [L, B, kvl, Tc, Dh]
+        # ks/vs [L, B, T0, kvl, Dh] → cache layout [L, B, kvl, Tc, Dh];
+        # windowed models roll: only the prompt's last Tc positions land,
+        # at their p mod Tc slots (see TransformerLM.prefill)
         kc = jnp.zeros((model.n_layers, B, kvl, Tc, Dh), cd)
         vc = jnp.zeros_like(kc)
-        kc = jax.lax.dynamic_update_slice_in_dim(
-            kc, ks.transpose(0, 1, 3, 2, 4), 0, axis=3)
-        vc = jax.lax.dynamic_update_slice_in_dim(
-            vc, vs.transpose(0, 1, 3, 2, 4), 0, axis=3)
+        kc, vc = write_prompt_cache(
+            kc, vc, ks.transpose(0, 1, 3, 2, 4),
+            vs.transpose(0, 1, 3, 2, 4), model.attn_window is not None)
 
         key, k0 = jax.random.split(key)
         first = select_tokens(logits[:, -1], k0, temperature, top_k, top_p,
@@ -335,38 +350,38 @@ def build_lm_tp_generate(model: TransformerLM, mesh: Mesh,
                 r_cos, r_sin = _rope_angles(pos_b, Dh, model.rope_theta)
                 r_cos, r_sin = r_cos[:, None, :], r_sin[:, None, :]
 
+            ring = model.attn_window is not None
+            tp_sum = lambda x: jax.lax.psum(x, TP_AXIS)
+
             def block(h, inputs):
                 lp, kcl, vcl = inputs  # kcl/vcl [B, kvl, Tc, Dh]
-                x = _layer_norm(
-                    h.astype(jnp.float32), lp["ln1_s"], lp["ln1_b"]
-                ).astype(cd)
-                q = (x @ lp["wq"].astype(cd)).reshape(B, hl, Dh)
-                k_new = (x @ lp["wk"].astype(cd)).reshape(B, kvl, 1, Dh)
-                v_new = (x @ lp["wv"].astype(cd)).reshape(B, kvl, 1, Dh)
+                x = model._norm_h(lp, "ln1", h).astype(cd)
+                q = model._attn_proj(lp, "q", x).reshape(B, hl, Dh)
+                k_new = model._attn_proj(lp, "k", x).reshape(B, kvl, 1, Dh)
+                v_new = model._attn_proj(lp, "v", x).reshape(B, kvl, 1, Dh)
                 if model.pos_encoding == "rotary":
                     q = _rope_rotate(q, r_cos, r_sin)
                     k_new = _rope_rotate(k_new, r_cos[:, None],
                                          r_sin[:, None])
+                widx = jnp.mod(p, kcl.shape[2]) if ring else p
                 kcl = jax.lax.dynamic_update_slice_in_dim(
-                    kcl, k_new, p, axis=2)
+                    kcl, k_new, widx, axis=2)
                 vcl = jax.lax.dynamic_update_slice_in_dim(
-                    vcl, v_new, p, axis=2)
+                    vcl, v_new, widx, axis=2)
                 qg = q.reshape(B, kvl, hl // kvl, Dh)
-                a = decode_attention(qg, kcl, vcl, p).astype(cd)
+                a = decode_attention(qg, kcl, vcl, p,
+                                     window=model.attn_window,
+                                     ring=ring).astype(cd)
                 part = a.reshape(B, hl * Dh) @ lp["wo"].astype(cd)
-                h = h + jax.lax.psum(part, TP_AXIS)
-                x = _layer_norm(
-                    h.astype(jnp.float32), lp["ln2_s"], lp["ln2_b"]
-                ).astype(cd)
-                u = jax.nn.relu(
-                    x @ lp["w1"].astype(cd) + lp["b1"].astype(cd))
-                part = u @ lp["w2"].astype(cd)
-                out = jax.lax.psum(part, TP_AXIS) + lp["b2"].astype(cd)
+                h = h + tp_sum(part)
+                if model.attn_bias:
+                    h = h + lp["bo"].astype(cd)
+                x = model._norm_h(lp, "ln2", h).astype(cd)
+                out = _tp_ffn(model, lp, x, cd, tp_sum)
                 return h + out.astype(cd), (kcl, vcl)
 
             h, (kc, vc) = jax.lax.scan(block, h, (lps, kc, vc))
-            h = _layer_norm(h.astype(jnp.float32), params["lnf_s"],
-                            params["lnf_b"])
+            h = model._norm_h(params, "lnf", h)
             return model._logits(params, h), kc, vc
 
         def step(carry, t):
@@ -396,7 +411,10 @@ def build_lm_tp_generate(model: TransformerLM, mesh: Mesh,
             raise ValueError(f"batch {B} not divisible by data axis {dp}")
         if n_new < 1:
             return prompt
-        Tc = aligned_cache_length(total)
+        Tc_req = total
+        if model.attn_window is not None:
+            Tc_req = min(total, model.attn_window) + 1  # ring + margin
+        Tc = aligned_cache_length(Tc_req)
         geom = (B, T0, int(n_new))
         if geom not in programs:
             programs[geom] = jax.jit(

@@ -171,6 +171,23 @@ def _spec_accept_row(vl_row, d_toks_row, d_probs_row, spec_k: int,
             n)
 
 
+def write_prompt_cache(kc, vc, ks, vs, windowed: bool):
+    """Prompt K/V ``ks``/``vs`` ``[L, B, H, T0, Dh]`` into the cache
+    ``kc``/``vc`` ``[L, B, H, Tc, Dh]`` at positions ``0..T0-1`` — THE
+    single home of the ring-write convention (rolling caches keep only
+    the prompt's last ``Tc`` positions, scattered to their ``p mod Tc``
+    slots; shorter prompts take the contiguous fast path, where
+    ``p mod Tc == p``). Shared by :meth:`TransformerLM.prefill` and the
+    tensor-parallel generator (``models/tensor_lm.py``)."""
+    T0, Tc = ks.shape[3], kc.shape[3]
+    if windowed and T0 > Tc:
+        slots = (np.arange(T0 - Tc, T0) % Tc).astype(np.int32)
+        return (kc.at[:, :, :, slots].set(ks[:, :, :, T0 - Tc:]),
+                vc.at[:, :, :, slots].set(vs[:, :, :, T0 - Tc:]))
+    return (jax.lax.dynamic_update_slice_in_dim(kc, ks, 0, axis=3),
+            jax.lax.dynamic_update_slice_in_dim(vc, vs, 0, axis=3))
+
+
 def _cache_update_rows(cache, new, pos, per_row: bool):
     """Write ``new`` ``[B, Hkv, S, Dh]`` into ``cache`` ``[B, Hkv, T, Dh]``
     at time offset ``pos`` — one shared scalar offset (plain
@@ -620,25 +637,9 @@ class TransformerLM:
         h, (ks, vs) = jax.lax.scan(block, h, lps)  # ks/vs [L, B, T0, Hkv, Dh]
         ks = ks.transpose(0, 1, 3, 2, 4)  # → cache layout [L, B, Hkv, T0, Dh]
         vs = vs.transpose(0, 1, 3, 2, 4)
-        Tc = cache["k"].shape[3]
-        if self.attn_window is not None and T0 > Tc:
-            # rolling buffer smaller than the prompt: keep only its last Tc
-            # positions (the earlier ones are outside every future query's
-            # window), scattered to their p mod Tc slots (a rotation)
-            slots = (np.arange(T0 - Tc, T0) % Tc).astype(np.int32)
-            cache = {
-                "k": cache["k"].at[:, :, :, slots].set(ks[:, :, :, T0 - Tc:]),
-                "v": cache["v"].at[:, :, :, slots].set(vs[:, :, :, T0 - Tc:]),
-            }
-        else:
-            # T0 <= Tc: slot p mod Tc == p — the ring write IS the
-            # contiguous slice update (no scatter cost on the common path)
-            cache = {
-                "k": jax.lax.dynamic_update_slice_in_dim(
-                    cache["k"], ks, 0, axis=3),
-                "v": jax.lax.dynamic_update_slice_in_dim(
-                    cache["v"], vs, 0, axis=3),
-            }
+        ck, cv = write_prompt_cache(cache["k"], cache["v"], ks, vs,
+                                    self.attn_window is not None)
+        cache = {"k": ck, "v": cv}
         h = self._norm_h(params, "lnf", h)
         return self._logits(params, h), cache
 

@@ -282,3 +282,20 @@ def test_imported_mixtral_generates_ep_sharded(tokens):
         gen = build_lm_generate(model, mesh)
         got = np.asarray(gen(model.shard_params(mesh, p), tokens, 6))
     np.testing.assert_array_equal(got, want)
+
+
+def test_imported_llama_generates_tensor_parallel(tokens):
+    # Megatron head-sharded serving of an imported checkpoint: KV cache
+    # memory drops by tp, rollout equals the gathered one
+    from elephas_tpu.models import build_lm_tp_generate, build_mesh_tp, \
+        shard_tp_params
+
+    hf = _tiny_llama(num_key_value_heads=2)
+    model, params = lm_from_hf(hf)
+    p = jax.tree.map(jnp.asarray, params)
+    mesh = build_mesh_tp(data=2, model=2)
+    with jax.default_matmul_precision("float32"):
+        want = np.asarray(model.generate(p, tokens, 6))
+        gen = build_lm_tp_generate(model, mesh, attn="dense")
+        got = np.asarray(gen(shard_tp_params(mesh, model, p), tokens, 6))
+    np.testing.assert_array_equal(got, want)
