@@ -184,19 +184,11 @@ def _tp_block(model: TransformerLM, h, lp, rope, attend, grad_mode: bool,
 def _tp_ffn(model: TransformerLM, lp, x_in, cd, tp_sum):
     """The FFN half of a TP block on column/row shards: ``w1``(+``w3``)
     column-sharded (their bias shards ride along), ``w2`` row-sharded,
-    ONE psum, replicated ``b2`` added after it."""
-    u = x_in @ lp["w1"].astype(cd)
-    if model.ffn_bias:
-        u = u + lp["b1"].astype(cd)
-    if model.activation == "swiglu":
-        u = jax.nn.silu(u) * (x_in @ lp["w3"].astype(cd))
-    elif model.activation == "gelu":
-        u = jax.nn.gelu(u, approximate=True)
-    else:
-        u = jax.nn.relu(u)
-    out = tp_sum(u @ lp["w2"].astype(cd))
-    if model.ffn_bias:
-        out = out + lp["b2"].astype(cd)
+    ONE psum, replicated ``b2`` added after it. The activation/bias
+    dispatch itself lives in ``TransformerLM._ffn`` (the ``reduce``
+    hook) — one home for the math, shards or not."""
+    del cd  # _ffn works in x_in's dtype
+    out, _ = model._ffn(lp, x_in, "dense", "seq", reduce=tp_sum)
     return out
 
 

@@ -533,12 +533,15 @@ class TransformerLM:
         return y
 
     def _ffn(self, lp, x, attn: str, seq_axis: str,
-             ep_groups: Optional[int] = None):
+             ep_groups: Optional[int] = None, reduce=None):
         """Per-block FFN hook → ``(residual_delta, aux_loss)``. The MoE
         variant overrides this with routed experts (which keep f32 routing
         regardless of ``compute_dtype`` — argmax ties must match the
         oracle); ``ep_groups`` overrides its dense-path dispatch grouping
-        (decode passes 1 — a single position has no groups)."""
+        (decode passes 1 — a single position has no groups). ``reduce``
+        sums partial ``w2`` outputs BEFORE the (replicated) ``b2`` — the
+        tensor-parallel caller's psum hook, keeping the activation/bias
+        dispatch in this one place (``models/tensor_lm.py``)."""
         del attn, seq_axis, ep_groups
         cd = x.dtype
         u = x @ lp["w1"].astype(cd)
@@ -552,6 +555,8 @@ class TransformerLM:
         else:
             u = jax.nn.relu(u)
         out = u @ lp["w2"].astype(cd)
+        if reduce is not None:
+            out = reduce(out)
         if self.ffn_bias:
             out = out + lp["b2"].astype(cd)
         return out, jnp.asarray(0.0, jnp.float32)

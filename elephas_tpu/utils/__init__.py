@@ -16,9 +16,11 @@ from .rdd_utils import (
 from .checkpoint import (
     load_checkpoint,
     load_pytree,
+    load_sharded_pytree,
     place_like,
     save_checkpoint,
     save_pytree,
+    save_sharded_pytree,
 )
 from .serialization import dict_to_model, model_to_dict
 from .sockets import determine_master, receive, receive_all, send
@@ -41,6 +43,8 @@ __all__ = [
     "load_checkpoint",
     "save_pytree",
     "load_pytree",
+    "save_sharded_pytree",
+    "load_sharded_pytree",
     "place_like",
     "determine_master",
     "send",

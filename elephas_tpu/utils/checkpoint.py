@@ -123,6 +123,52 @@ def place_like(template: Any, host_tree: Any) -> Any:
     return jax.tree_util.tree_map(put, template, host_tree)
 
 
+def save_sharded_pytree(path: str, tree: Any) -> None:
+    """Checkpoint a (possibly sharded) pytree WITHOUT gathering it.
+
+    The scale-out complement to :func:`save_pytree`: orbax/tensorstore
+    writes each array shard from the process that owns it (OCDBT format),
+    so a multi-host FSDP/TP state checkpoints with no host ever
+    materializing the full tree — the npz path gathers everything to
+    process 0, which is exactly what breaks once the sharded state is
+    larger than one host. Restore with :func:`load_sharded_pytree`.
+
+    All processes must call this (collective); it blocks until the write
+    is durable.
+    """
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.abspath(path), tree, force=True)
+    ckptr.wait_until_finished()
+
+
+def load_sharded_pytree(path: str, template: Any = None) -> Any:
+    """Restore a :func:`save_sharded_pytree` checkpoint.
+
+    ``template`` is a same-structure tree whose leaves carry the TARGET
+    shardings (e.g. ``opt_init(params)`` or ``model.shard_params(...)``;
+    values ignored) — each process reads only its own shards and the
+    result is ready for the compiled step, no host round-trip. With
+    ``template=None`` the full arrays load host-side (the
+    :func:`load_pytree` analog). The saved and restoring mesh layouts
+    may differ: tensorstore reads whatever slices the new sharding asks
+    for.
+    """
+    import jax
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.StandardCheckpointer()
+    if template is None:
+        return ckptr.restore(os.path.abspath(path))
+    abstract = jax.tree_util.tree_map(
+        lambda t: jax.ShapeDtypeStruct(
+            t.shape, t.dtype, sharding=getattr(t, "sharding", None)),
+        template,
+    )
+    return ckptr.restore(os.path.abspath(path), abstract)
+
+
 def load_checkpoint(directory: str) -> Tuple[List[np.ndarray], Dict[str, Any], Any]:
     """Returns ``(weights, meta, opt_state_or_None)``."""
     weights = load_weights_npz(os.path.join(directory, "weights.npz"))
