@@ -532,6 +532,90 @@ def config7_speculative():
     return out
 
 
+def config8_moe_lm():
+    """Mixtral-shaped MoE LM training throughput + model-FLOPs MFU.
+
+    One chip holds ALL experts (the expert axis has size 1 here; multi-chip
+    shards them — ``dryrun_multichip``), so this measures the routing
+    machinery's single-chip cost: tokens/sec and an MFU whose denominator
+    counts MODEL FLOPs only (attention + router + the k ACTIVE experts per
+    token, swiglu-aware) — the GShard dispatch/combine einsums are counted
+    as OVERHEAD, not useful FLOPs, so the gap between this MFU and the
+    dense LM's at equal active FLOPs IS the price of routing. TPU-gated
+    (BENCH_ALL_MOE=1 forces).
+    """
+    import jax
+    import numpy as np
+    import optax
+
+    gate = os.environ.get("BENCH_ALL_MOE", "auto")
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if gate == "0" or (gate == "auto" and not on_tpu):
+        log("config8 moe: skipped (not on TPU; BENCH_ALL_MOE=1 forces)")
+        return {"skipped": "not on TPU"}
+
+    from elephas_tpu.models import (
+        MoETransformerLM, adam_compact, build_lm_train_step, build_mesh_sp,
+        make_lm_batches, shard_lm_batch,
+    )
+
+    D, L, H, F = 1024, 4, 8, 4096
+    E, K = 8, 2
+    V, T, B = 8192, 1024, 4
+    steps, reps = 10, 3
+    model = MoETransformerLM(
+        vocab=V, d_model=D, n_heads=H, n_layers=L, d_ff=F, max_len=T,
+        n_experts=E, k=K, capacity_factor=1.25, compute_dtype="bfloat16",
+        pos_encoding="rotary", tie_embeddings=True, activation="swiglu",
+        norm="rmsnorm", ffn_bias=False,
+    )
+    mesh = build_mesh_sp(data=1, seq=1)
+    step, opt_init = build_lm_train_step(model, mesh, adam_compact(1e-3),
+                                         attn="flash")
+    params = model.shard_params(mesh, model.init(seed=0))
+    state = opt_init(params)
+    rows = np.random.default_rng(0).integers(0, V, size=(B, T + 1))
+    batch = shard_lm_batch(mesh, *make_lm_batches(rows))
+
+    log(f"config8 moe: d{D} L{L} E{E} k{K} F{F} T{T} B{B} bf16 swiglu "
+        "(compiling...)")
+    for _ in range(2):
+        params, state, loss = step(params, state, *batch)
+    float(loss)
+
+    best = float("inf")
+    for rep in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, state, loss = step(params, state, *batch)
+        last = float(loss)
+        dt = time.perf_counter() - t0
+        log(f"config8 rep {rep}: {dt / steps * 1e3:.1f} ms/step")
+        best = min(best, dt)
+    assert np.isfinite(last), last
+
+    # model FLOPs/token (fwd, x3 train): attention qkvo + causal dots,
+    # router D*E, k active swiglu experts (3 matmuls each), tied head
+    attn = L * (2 * (2 * D * D + 2 * D * D) + 4 * D * (T + 1) / 2)
+    ffn = L * (2 * D * E + K * 3 * 2 * D * F)
+    flops_tok = 3.0 * (attn + ffn + 2 * D * V)
+    tok_s = B * T * steps / best
+    import bench as _bench
+    peak = _bench.peak_bf16_flops(jax.devices()[0])
+    mfu = flops_tok * tok_s / peak if peak else None
+    log(f"config8 moe: {tok_s:,.0f} tok/s, "
+        f"{flops_tok * tok_s / 1e12:.1f} TF/s model flops"
+        + (f", MFU {mfu * 100:.1f}%" if mfu else ""))
+    return {
+        "tokens_per_sec": round(tok_s, 1),
+        "model_flops_mfu": round(mfu, 4) if mfu else None,
+        "step_ms": round(best / steps * 1e3, 2),
+        "flops_per_token_model_only": round(flops_tok),
+        "active_params_per_token_frac": round(K / E, 3),
+        "config": f"d{D}xL{L}xE{E}k{K}xF{F}xT{T}xB{B}-swiglu-bf16",
+    }
+
+
 def main():
     from harness_env import cpu_mesh_env, probe_backend
 
@@ -552,6 +636,7 @@ def main():
         ("hyperparam_search", config5_hyperparam),
         ("conv_mfu", config6_conv_mfu),
         ("speculative", config7_speculative),
+        ("moe_lm", config8_moe_lm),
     ):
         try:
             results[name] = fn()
