@@ -161,12 +161,25 @@ def load_sharded_pytree(path: str, template: Any = None) -> Any:
     ckptr = ocp.StandardCheckpointer()
     if template is None:
         return ckptr.restore(os.path.abspath(path))
-    abstract = jax.tree_util.tree_map(
-        lambda t: jax.ShapeDtypeStruct(
-            t.shape, t.dtype, sharding=getattr(t, "sharding", None)),
-        template,
-    )
-    return ckptr.restore(os.path.abspath(path), abstract)
+
+    def abstract(t):
+        sharding = getattr(t, "sharding", None)
+        if sharding is None:
+            # A host-numpy template would silently degrade to a full-array
+            # load per process, defeating the each-process-reads-its-own-
+            # shards contract — refuse instead of quietly doing that.
+            raise TypeError(
+                "load_sharded_pytree: template leaf of type "
+                f"{type(t).__name__} (shape {getattr(t, 'shape', '?')}) has "
+                "no .sharding — pass a device-placed template (e.g. "
+                "model.shard_params(mesh, model.init()) or "
+                "opt_init(sharded_params)), or template=None for an "
+                "explicit full host-side load"
+            )
+        return jax.ShapeDtypeStruct(t.shape, t.dtype, sharding=sharding)
+
+    return ckptr.restore(os.path.abspath(path),
+                         jax.tree_util.tree_map(abstract, template))
 
 
 def load_checkpoint(directory: str) -> Tuple[List[np.ndarray], Dict[str, Any], Any]:
