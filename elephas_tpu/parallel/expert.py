@@ -99,6 +99,94 @@ def _top_k_dispatch(gates, capacity: int, k: int):
     return dispatch, combine, aux
 
 
+def _top_k_select(gates, capacity: int, k: int):
+    """:func:`_top_k_dispatch`'s selection in INDEX form (no ``[N, E, C]``
+    tensors): same iterated-argmax choice order, same GShard priority rule
+    (k-th choices queue behind all (k-1)-th choices), same keep-if-slot<C
+    decision, same renormalized combine weights — so a grouped-matmul
+    executor can reproduce the one-hot path's routing bit-for-bit.
+
+    Returns ``(eidx [N, k] int32, slot [N, k] int32, combine [N, k],
+    (c1 [E], gsum [E]))`` where ``slot`` is each choice's capacity-queue
+    position at its expert (``>= capacity`` ⇔ dropped), ``combine`` is
+    zero for dropped choices, and ``c1``/``gsum`` are the aux-loss
+    ingredients (first-choice counts, summed router probs).
+    """
+    n, e = gates.shape
+    g = gates
+    eidxs, slots, keeps = [], [], []
+    counts = jnp.zeros((e,), gates.dtype)
+    first = None
+    for _ in range(k):
+        idx = jnp.argmax(g, axis=-1)
+        m = jax.nn.one_hot(idx, e, dtype=gates.dtype)
+        if first is None:
+            first = m
+        pos = jnp.cumsum(m, axis=0) - m + counts[None, :]
+        slot = jnp.sum(pos * m, axis=-1)  # [N] queue position at its expert
+        keeps.append(slot < capacity)
+        slots.append(slot.astype(jnp.int32))
+        eidxs.append(idx.astype(jnp.int32))
+        counts = counts + jnp.sum(m, axis=0)
+        g = g * (1.0 - m)  # exclude chosen expert from the next round
+    eidx = jnp.stack(eidxs, axis=1)
+    slot = jnp.stack(slots, axis=1)
+    keep = jnp.stack(keeps, axis=1)
+    gv = jnp.take_along_axis(gates, eidx, axis=1) * keep.astype(gates.dtype)
+    denom = jnp.maximum(jnp.sum(gv, axis=1, keepdims=True), 1e-9)
+    return eidx, slot, gv / denom, (jnp.sum(first, axis=0),
+                                    jnp.sum(gates, axis=0))
+
+
+@jax.custom_vjp
+def _rows_to_slots(x, tos, flat, keep):
+    """``blocks_flat[s] = x[tos[s]]`` (sentinel rows → 0), with a GATHER
+    backward: TPU scatter-add (the default transpose of a gather) serializes
+    on row conflicts, but the slot assignment is injective — token ``n``'s
+    kept copies live exactly at ``flat[n, j]`` — so ``dx[n]`` is a gather of
+    those ``k`` rows masked by ``keep`` and summed. ``tos [S]`` maps slot →
+    token (sentinel = n), ``flat [N, k]`` maps (token, choice) → slot
+    (clipped for drops), ``keep [N, k]`` masks dropped choices."""
+    return jnp.take(x, tos, axis=0, mode="fill", fill_value=0)
+
+
+def _rows_to_slots_fwd(x, tos, flat, keep):
+    return _rows_to_slots(x, tos, flat, keep), (tos, flat, keep)
+
+
+def _rows_to_slots_bwd(res, g):
+    _, flat, keep = res
+    n, k = flat.shape
+    dx = jnp.take(g, flat.reshape(-1), axis=0).reshape(n, k, -1)
+    dx = jnp.sum(dx * keep[..., None].astype(g.dtype), axis=1)
+    return dx, None, None, None
+
+
+_rows_to_slots.defvjp(_rows_to_slots_fwd, _rows_to_slots_bwd)
+
+
+@jax.custom_vjp
+def _slots_to_rows(out_flat, flat, cell):
+    """``rows[i] = out_flat[flat[i]]`` for flattened (token, choice) ``i``,
+    with a GATHER backward: ``cell [S]`` is the inverse map (slot → claiming
+    flat pair, sentinel = N·k ⇒ out-of-bounds ⇒ zero fill). Dropped pairs
+    read a clipped slot forward but their cotangent is zero (combine weight
+    0), so the inverse covering only KEPT pairs is exact."""
+    return jnp.take(out_flat, flat, axis=0)
+
+
+def _slots_to_rows_fwd(out_flat, flat, cell):
+    return _slots_to_rows(out_flat, flat, cell), cell
+
+
+def _slots_to_rows_bwd(cell, g):
+    return (jnp.take(g, cell, axis=0, mode="fill", fill_value=0),
+            None, None)
+
+
+_slots_to_rows.defvjp(_slots_to_rows_fwd, _slots_to_rows_bwd)
+
+
 def _expert_choice_dispatch(gates, capacity: int):
     """Expert-choice routing (Zhou et al. 2022): each EXPERT picks its
     top-``capacity`` tokens by gate score (ties break to the lowest token
@@ -236,40 +324,251 @@ class MoEFeedForward:
         ``axis_name``), so it equals the oracle's value exactly."""
         n_l = x.shape[0]
         cap = self.capacity(n_l)
+        D = self.d_model
+        E = self.n_experts
+        f32 = jnp.float32
         gates = jax.nn.softmax(jnp.dot(x, params["wg"]), axis=-1)
+        # Dispatch is INDEX-FORM (gather/scatter), not one-hot einsums: the
+        # [N, E, C] dispatch/combine products cost O(N·E·C·D) FLOPs and —
+        # because the one-hot tensors are f32 — used to promote the token
+        # blocks (and therefore the whole expert FFN) to f32. Building
+        # blocks by gather keeps them in the compute dtype and spends only
+        # O(E·C·D) bandwidth; routing decisions, capacity keeps, and
+        # combine weights are bit-identical (same _top_k_select math the
+        # one-hot oracle reproduces). Combine math stays f32.
         if self.routing == "expert_choice":
             # an expert cannot pick more tokens than the shard holds
-            ec_dispatch, ec_combine = _expert_choice_dispatch(
-                gates, min(cap, n_l)
-            )
-            blocks = jnp.einsum("ecn,nd->ecd", ec_dispatch, x)
+            ec_vals, ec_idx = jax.lax.top_k(gates.T, min(cap, n_l))
+            blocks = jnp.take(x, ec_idx.reshape(-1), axis=0).reshape(
+                E, -1, D)
         else:
-            dispatch, combine, (c1, gsum, ntok) = _top_k_dispatch(
-                gates, cap, self.k
-            )
-            # [N_l, E, C] × [N_l, D] → [E, C, D]
-            blocks = jnp.einsum("nec,nd->ecd", dispatch, x)
+            blocks, cell, flat, combine, c1, gsum = self._slot_dispatch(
+                x, gates, cap)
         # E→local experts, gather the P source shards' slots:
         # [E, C, D] → [E/P, P·C, D]
         blocks = jax.lax.all_to_all(
             blocks, axis_name, split_axis=0, concat_axis=1, tiled=True
         )
-        out = jax.vmap(self._expert_ffn)(*self._expert_args(params), blocks)
+        # expert weights cast to the block dtype (bf16 models run their
+        # experts on the MXU fast path; f32 models are unchanged)
+        args = [a.astype(blocks.dtype) for a in self._expert_args(params)]
+        out = jax.vmap(self._expert_ffn)(*args, blocks)
         # transpose re-shard: [E/P, P·C, D] → [E, C, D]
         out = jax.lax.all_to_all(
             out, axis_name, split_axis=1, concat_axis=0, tiled=True
         )
         if self.routing == "expert_choice":
+            # scatter-add each expert's slots home, gate-weighted (f32);
             # perfectly balanced by construction → no aux loss
-            return (jnp.einsum("ecn,ecd->nd", ec_combine, out),
-                    jnp.asarray(0.0, jnp.float32))
-        y = jnp.einsum("nec,ecd->nd", combine, out)
+            y = jnp.zeros((n_l, D), f32).at[ec_idx.reshape(-1)].add(
+                out.reshape(-1, D).astype(f32)
+                * ec_vals.reshape(-1)[:, None].astype(f32))
+            return y, jnp.asarray(0.0, jnp.float32)
+        y = self._slot_combine(out, cell, flat, combine, n_l)
         # Switch aux loss on group-global stats: E · Σ_e f_e · p_e
         c1 = jax.lax.psum(c1, axis_name)
         gsum = jax.lax.psum(gsum, axis_name)
-        nt = jax.lax.psum(ntok, axis_name)
+        nt = jax.lax.psum(
+            jnp.asarray(float(n_l), gates.dtype), axis_name)
         aux = self.n_experts * jnp.sum((c1 / nt) * (gsum / nt))
         return y, aux
+
+    def _slot_dispatch(self, x, gates, cap: int):
+        """token_choice index-form dispatch: ``x [N, D]`` + router ``gates``
+        → ``(blocks [E, C, D], cell, flat, combine, c1, gsum)``.
+
+        ONE small int scatter builds the inverse map ``cell[s]`` = the
+        flattened (token, choice) pair claiming slot ``s`` (sentinel =
+        ``N·k`` for empty cells; over-capacity pairs index out of bounds
+        on the slot dim and are dropped). Everything else — the block
+        build, the combine, and BOTH their AD transposes — is then pure
+        gathers (:func:`_rows_to_slots` / :func:`_slots_to_rows`), and the
+        blocks stay in ``x``'s dtype (no f32 promotion through one-hot
+        products)."""
+        n_l, E = x.shape[0], self.n_experts
+        eidx, slot, combine, (c1, gsum) = _top_k_select(gates, cap, self.k)
+        sent = n_l * self.k
+        pair = jnp.arange(sent, dtype=jnp.int32).reshape(n_l, self.k)
+        cell = jnp.full((E, cap), sent, jnp.int32).at[
+            eidx.reshape(-1), slot.reshape(-1)
+        ].set(pair.reshape(-1), mode="drop").reshape(-1)
+        tok_of_cell = jnp.where(cell == sent, n_l, cell // self.k)
+        keep = slot < cap
+        flat = eidx * cap + jnp.minimum(slot, cap - 1)  # [N, k] slot ids
+        # sentinel rows (empty slots) gather as zeros — exactly the
+        # one-hot dispatch's zero padding
+        blocks = _rows_to_slots(x, tok_of_cell, flat, keep).reshape(
+            E, cap, self.d_model)
+        return blocks, cell, flat, combine, c1, gsum
+
+    def _slot_combine(self, out, cell, flat, combine, n_l: int):
+        """Weighted gather of each token's k expert outputs (f32 math)."""
+        f32 = jnp.float32
+        rows = _slots_to_rows(
+            out.reshape(-1, self.d_model), flat.reshape(-1), cell
+        ).reshape(n_l, self.k, self.d_model).astype(f32)
+        return jnp.sum(rows * combine[..., None].astype(f32), axis=1)
+
+    def apply_slots(self, params: Dict[str, Any], x, ep: int = 1):
+        """:meth:`apply_reference`'s contract executed by the index-form
+        (gather) dispatch — the sharded path's exact math with the
+        all_to_alls elided. The fastest single-device executor measured on
+        TPU (no ``[N, E, C]`` products, blocks stay in the compute dtype,
+        both AD transposes are gathers). ``token_choice`` only."""
+        if self.routing != "token_choice":
+            raise ValueError(
+                "apply_slots implements token_choice routing only; "
+                "use apply_reference for expert_choice")
+        n = x.shape[0]
+        if n % ep:
+            raise ValueError(f"{n} tokens not divisible by ep={ep}")
+        cap = self.capacity(n // ep)
+        args = None
+        ys, c1s, gsums = [], [], []
+        for blk in jnp.split(x, ep, axis=0):
+            gates = jax.nn.softmax(jnp.dot(blk, params["wg"]), axis=-1)
+            blocks, cell, flat, combine, c1, gsum = self._slot_dispatch(
+                blk, gates, cap)
+            if args is None:
+                args = [a.astype(blocks.dtype)
+                        for a in self._expert_args(params)]
+            out = jax.vmap(self._expert_ffn)(*args, blocks)
+            ys.append(self._slot_combine(out, cell, flat, combine,
+                                         blk.shape[0]))
+            c1s.append(c1)
+            gsums.append(gsum)
+        c1, gsum = sum(c1s), sum(gsums)
+        aux = self.n_experts * jnp.sum((c1 / n) * (gsum / n))
+        return jnp.concatenate(ys, axis=0), aux
+
+    def _grouped_block(self, params, x, capacity: int):
+        """One dispatch group via sort + ragged grouped matmul.
+
+        The megablocks-style single-device executor: flatten the (token,
+        choice) pairs, stable-sort them by expert, run each projection as
+        ONE ``jax.lax.ragged_dot`` over contiguous per-expert row blocks,
+        unsort, and combine-weight the k contributions per token. Exactly
+        ``k·N`` rows hit the MXU — no capacity padding (``cf·k·N`` slots)
+        and no ``[N, E, C]`` one-hot dispatch/combine products, which is
+        what prices the one-hot path at ~half the single-chip step
+        (docs/PERFORMANCE.md config 8). Routing math is shared with the
+        one-hot path (:func:`_top_k_select`), so keep/drop decisions and
+        combine weights are identical; over-capacity pairs still occupy
+        their sorted rows but carry zero combine weight (static shapes,
+        exact math).
+        """
+        f32 = jnp.float32
+        n = x.shape[0]
+        gates = jax.nn.softmax(
+            jnp.dot(x.astype(f32), params["wg"].astype(f32)), axis=-1)
+        eidx, _, combine, (c1, gsum) = _top_k_select(gates, capacity, self.k)
+        cd = x.dtype
+        eflat = eidx.reshape(n * self.k)
+        order = jnp.argsort(eflat, stable=True)   # sorted-by-expert rows
+        inv = jnp.argsort(order, stable=True)     # sorted row -> flat slot
+        xs = jnp.take(x, order // self.k, axis=0)            # [k·N, D]
+        sizes = jnp.bincount(
+            eflat, length=self.n_experts).astype(jnp.int32)  # [E]
+        if self.bias:
+            es = jnp.take(eflat, order)  # sorted expert id per row
+
+        def rdot(key, rows):
+            return jax.lax.ragged_dot(rows, params[key].astype(cd), sizes)
+
+        u = rdot("w1", xs)
+        if self.bias:
+            u = u + jnp.take(params["b1"].astype(cd), es, axis=0)
+        if self.activation == "swiglu":
+            u = jax.nn.silu(u) * rdot("w3", xs)
+        elif self.activation == "gelu":
+            u = jax.nn.gelu(u, approximate=True)
+        else:
+            u = jax.nn.relu(u)
+        out = rdot("w2", u)
+        if self.bias:
+            out = out + jnp.take(params["b2"].astype(cd), es, axis=0)
+        out = jnp.take(out, inv, axis=0).reshape(n, self.k, self.d_model)
+        y = jnp.sum(out * combine[..., None].astype(cd), axis=1)
+        return y, c1, gsum
+
+    def apply_grouped(self, params: Dict[str, Any], x, ep: int = 1):
+        """Single-device grouped-matmul MoE: :meth:`apply_reference`'s
+        contract (same routing, same per-``ep``-group capacity quotas, same
+        aux loss) executed by sort + :func:`jax.lax.ragged_dot` instead of
+        dense one-hot einsums — ``k·N`` MXU rows instead of ``cf·k·N``
+        padded slots plus quadratic dispatch products. ``token_choice``
+        only (expert-choice keeps the one-hot oracle). Returns
+        ``(y [N, D], aux_loss)``; matches :meth:`apply_reference` to float
+        tolerance (identical routing decisions, different summation
+        order)."""
+        if self.routing != "token_choice":
+            raise ValueError(
+                "apply_grouped implements token_choice routing only; "
+                "use apply_reference for expert_choice")
+        n = x.shape[0]
+        if n % ep:
+            raise ValueError(f"{n} tokens not divisible by ep={ep}")
+        cap = self.capacity(n // ep)
+        ys, c1s, gsums = [], [], []
+        for blk in jnp.split(x, ep, axis=0):
+            y, c1, gsum = self._grouped_block(params, blk, cap)
+            ys.append(y)
+            c1s.append(c1)
+            gsums.append(gsum)
+        c1, gsum = sum(c1s), sum(gsums)
+        aux = self.n_experts * jnp.sum((c1 / n) * (gsum / n))
+        return jnp.concatenate(ys, axis=0), aux
+
+    def apply_partial(self, params: Dict[str, Any], x, n_local: int,
+                      e0):
+        """Expert-PARTIAL forward for replicated-routing layouts: routing
+        over all ``E`` experts computes locally (``wg`` replicated, ``x``
+        replicated across the expert-sharded axis), but only the caller's
+        ``n_local`` expert shard (global rows ``e0..e0+n_local``) runs —
+        the returned ``y`` is that shard's partial combine, and the CALLER
+        psums partials across the axis (experts partition the combine sum,
+        so Σ_ranks partial == the full MoE output, bit-equal to
+        :meth:`apply_reference` with ``ep=1``).
+
+        The decode-path complement to :meth:`apply` (whose all_to_all +
+        per-shard token quotas suit big training batches): no token
+        slicing, so any batch size works — the tensor-parallel MoE decode
+        uses it per position. ``token_choice`` only. ``e0`` may be traced
+        (``axis_index``-derived). Expert stacks in ``params`` are the
+        LOCAL ``[n_local, ...]`` shards; capacity uses the single-group
+        (``ep=1``) convention.
+        """
+        if self.routing != "token_choice":
+            raise ValueError(
+                "apply_partial implements token_choice routing only")
+        n = x.shape[0]
+        cap = self.capacity(n)
+        D = self.d_model
+        f32 = jnp.float32
+        gates = jax.nn.softmax(jnp.dot(x, params["wg"]), axis=-1)
+        eidx, slot, combine, _ = _top_k_select(gates, cap, self.k)
+        # global slot→pair map, then THIS shard's rows only
+        sent = n * self.k
+        pair = jnp.arange(sent, dtype=jnp.int32).reshape(n, self.k)
+        cell = jnp.full((self.n_experts, cap), sent, jnp.int32).at[
+            eidx.reshape(-1), slot.reshape(-1)
+        ].set(pair.reshape(-1), mode="drop")
+        cell_l = jax.lax.dynamic_slice_in_dim(cell, e0, n_local,
+                                              axis=0).reshape(-1)
+        tok_l = jnp.where(cell_l == sent, n, cell_l // self.k)
+        blocks = jnp.take(x, tok_l, axis=0, mode="fill",
+                          fill_value=0).reshape(n_local, cap, D)
+        args = [a.astype(blocks.dtype) for a in self._expert_args(params)]
+        out = jax.vmap(self._expert_ffn)(*args, blocks)
+        # partial combine: only pairs routed to THIS shard contribute
+        local = (eidx >= e0) & (eidx < e0 + n_local)
+        flat = (eidx - e0) * cap + jnp.minimum(slot, cap - 1)
+        rows = jnp.take(
+            out.reshape(n_local * cap, D),
+            jnp.clip(flat, 0, n_local * cap - 1).reshape(-1), axis=0,
+        ).reshape(n, self.k, D).astype(f32)
+        w = jnp.where(local, combine, 0.0)
+        return jnp.sum(rows * w[..., None].astype(f32), axis=1)
 
     def apply_reference(self, params: Dict[str, Any], x, ep: int = 1):
         """Single-device oracle: identical routing math, full expert stack.

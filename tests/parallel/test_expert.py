@@ -178,6 +178,104 @@ def test_expert_choice_trains():
     assert losses[-1] < losses[0] * 0.8, losses
 
 
+@pytest.mark.parametrize(
+    "activation,bias,cf,k,ep",
+    [
+        ("relu", True, 1.5, 2, 1),
+        ("gelu", True, 1.25, 1, 2),
+        ("swiglu", False, 2.0, 2, 1),   # Mixtral expert shape
+        ("swiglu", False, 0.25, 2, 1),  # capacity binds: drops must match
+        ("relu", False, 1.0, 3, 4),     # multi-group per-shard quotas
+    ],
+)
+def test_grouped_matches_onehot_oracle(activation, bias, cf, k, ep):
+    """The sort + ragged-grouped-matmul executor must reproduce the one-hot
+    dispatch oracle exactly (same routing, keeps, combine weights, aux) —
+    only float summation order may differ."""
+    model = MoEFeedForward(d_model=8, d_ff=16, n_experts=8, k=k,
+                           capacity_factor=cf, activation=activation,
+                           bias=bias)
+    params = model.init(seed=4)
+    x = jnp.asarray(_tokens(n=64, d=8, seed=7))
+    want, aux_want = model.apply_reference(params, x, ep=ep)
+    got, aux_got = model.apply_grouped(params, x, ep=ep)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(aux_got), float(aux_want), rtol=1e-5)
+
+
+def test_grouped_gradients_match_onehot():
+    """jax.grad through the grouped executor equals the one-hot oracle's
+    gradients (routing is piecewise-constant; both paths stop gradients at
+    the same argmax decisions)."""
+    model = MoEFeedForward(d_model=8, d_ff=16, n_experts=4, k=2,
+                           capacity_factor=1.5)
+    params = {k: jnp.asarray(v) for k, v in model.init(seed=9).items()}
+    x = jnp.asarray(_tokens(n=32, d=8, seed=11))
+    y = jnp.asarray(_tokens(n=32, d=8, seed=12))
+
+    def loss(p, fn):
+        h, aux = fn(p, x)
+        return jnp.mean(_mse(y, x + h)) + 1e-2 * aux
+
+    g_ref = jax.grad(lambda p: loss(p, model.apply_reference))(params)
+    g_grp = jax.grad(lambda p: loss(p, model.apply_grouped))(params)
+    for k_ in params:
+        np.testing.assert_allclose(
+            np.asarray(g_grp[k_]), np.asarray(g_ref[k_]),
+            rtol=2e-5, atol=2e-6, err_msg=k_)
+
+
+@pytest.mark.parametrize(
+    "activation,bias,cf,k,ep",
+    [
+        ("relu", True, 1.5, 2, 1),
+        ("swiglu", False, 2.0, 2, 1),   # Mixtral expert shape
+        ("swiglu", False, 0.25, 2, 1),  # capacity binds: drops must match
+        ("gelu", True, 1.0, 3, 4),      # multi-group per-shard quotas
+    ],
+)
+def test_slots_matches_onehot_oracle(activation, bias, cf, k, ep):
+    """The index-form (gather) slot executor must reproduce the one-hot
+    dispatch oracle exactly — same keeps, drops, combine weights, aux."""
+    model = MoEFeedForward(d_model=8, d_ff=16, n_experts=8, k=k,
+                           capacity_factor=cf, activation=activation,
+                           bias=bias)
+    params = model.init(seed=4)
+    x = jnp.asarray(_tokens(n=64, d=8, seed=7))
+    want, aux_want = model.apply_reference(params, x, ep=ep)
+    got, aux_got = model.apply_slots(params, x, ep=ep)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(float(aux_got), float(aux_want), rtol=1e-5)
+
+
+def test_slots_gradients_match_onehot():
+    model = MoEFeedForward(d_model=8, d_ff=16, n_experts=4, k=2,
+                           capacity_factor=1.25)
+    params = {k: jnp.asarray(v) for k, v in model.init(seed=9).items()}
+    x = jnp.asarray(_tokens(n=32, d=8, seed=11))
+    y = jnp.asarray(_tokens(n=32, d=8, seed=12))
+
+    def loss(p, fn):
+        h, aux = fn(p, x)
+        return jnp.mean(_mse(y, x + h)) + 1e-2 * aux
+
+    g_ref = jax.grad(lambda p: loss(p, model.apply_reference))(params)
+    g_slt = jax.grad(lambda p: loss(p, model.apply_slots))(params)
+    for k_ in params:
+        np.testing.assert_allclose(
+            np.asarray(g_slt[k_]), np.asarray(g_ref[k_]),
+            rtol=2e-5, atol=2e-6, err_msg=k_)
+
+
+def test_grouped_rejects_expert_choice():
+    model = MoEFeedForward(d_model=4, d_ff=8, n_experts=4,
+                           routing="expert_choice")
+    with pytest.raises(ValueError, match="token_choice"):
+        model.apply_grouped(model.init(0), jnp.zeros((8, 4)))
+
+
 def test_validation():
     with pytest.raises(ValueError):
         MoEFeedForward(d_model=4, d_ff=8, n_experts=1, k=2)
