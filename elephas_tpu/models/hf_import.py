@@ -31,7 +31,9 @@ llama     swiglu + rmsnorm + rotary (theta, GQA from config);
 mistral   llama mapping + ``attn_window`` = the config's sliding
           window (real SWA through the flash/decode kernels)
 qwen2     llama mapping + q/k/v biases (o bias zero-filled);
-          ``attn_window`` when ``use_sliding_window``
+          ``attn_window`` when ``use_sliding_window`` — including
+          MIXED per-layer patterns (``layer_types`` /
+          ``max_window_layers``) as a per-layer window list
 mixtral   llama attention + sparse-MoE FFN → ``MoETransformerLM``
           (swiglu experts, top-k renormalized routing; capacity
           pinned to never bind so routing equals HF's exactly)
@@ -58,6 +60,18 @@ def _np(t) -> np.ndarray:
     return t.detach().cpu().numpy().astype(np.float32)
 
 
+def _take(sd, key) -> np.ndarray:
+    """Pop ``key`` from the state dict and convert to host f32.
+
+    Popping (rather than indexing) lets :func:`load_hf_lm` free each torch
+    tensor as soon as it is converted: once the torch model itself is
+    released, the popped dict holds the only reference, so peak host RAM
+    stays near one copy of the checkpoint instead of torch + numpy
+    coexisting for the whole conversion.
+    """
+    return _np(sd.pop(key))
+
+
 def _check(cond: bool, what: str) -> None:
     if not cond:
         raise NotImplementedError(f"hf_import: {what}")
@@ -69,6 +83,9 @@ def _from_gpt2(cfg, sd) -> Tuple[TransformerLM, Dict[str, np.ndarray]]:
            "checkpoints use the tanh-approximated gelu)")
     _check(not getattr(cfg, "scale_attn_by_inverse_layer_idx", False),
            "scale_attn_by_inverse_layer_idx")
+    _check(getattr(cfg, "scale_attn_weights", True),
+           "scale_attn_weights=False (this framework always scales scores "
+           "by 1/sqrt(head_dim); importing would silently change logits)")
     L, D = cfg.n_layer, cfg.n_embd
     model = TransformerLM(
         vocab=cfg.vocab_size, d_model=D, n_heads=cfg.n_head, n_layers=L,
@@ -79,14 +96,14 @@ def _from_gpt2(cfg, sd) -> Tuple[TransformerLM, Dict[str, np.ndarray]]:
     )
     pre = "transformer."
     params: Dict[str, Any] = {
-        "tok": _np(sd[pre + "wte.weight"]),
-        "pos": _np(sd[pre + "wpe.weight"]),
-        "lnf_s": _np(sd[pre + "ln_f.weight"]),
-        "lnf_b": _np(sd[pre + "ln_f.bias"]),
+        "tok": _take(sd, pre + "wte.weight"),
+        "pos": _take(sd, pre + "wpe.weight"),
+        "lnf_s": _take(sd, pre + "ln_f.weight"),
+        "lnf_b": _take(sd, pre + "ln_f.bias"),
     }
 
     def stack(fmt):
-        return np.stack([_np(sd[pre + fmt.format(i)]) for i in range(L)])
+        return np.stack([_take(sd, pre + fmt.format(i)) for i in range(L)])
 
     params["ln1_s"] = stack("h.{}.ln_1.weight")
     params["ln1_b"] = stack("h.{}.ln_1.bias")
@@ -121,11 +138,13 @@ def _from_llama_family(cfg, sd, family: str
     max_len = cfg.max_position_embeddings
     window = getattr(cfg, "sliding_window", None)
     windowed = family == "mistral" and window is not None
+    per_layer = None
     if (family == "qwen2" and window is not None
             and getattr(cfg, "use_sliding_window", False)):
         # Qwen2 windows only SOME layers (layer_types /
-        # max_window_layers); the global attn_window knob is exact only
-        # when every layer slides — or none does (plain causal import).
+        # max_window_layers): import as a PER-LAYER attn_window list —
+        # TransformerLM's per-layer window support (period-decomposed
+        # layer scans, per-layer decode masks) makes the import exact.
         lt = getattr(cfg, "layer_types", None)
         if lt is not None:
             sliding = [t == "sliding_attention" for t in lt]
@@ -134,13 +153,16 @@ def _from_llama_family(cfg, sd, family: str
             sliding = [i >= mwl for i in range(cfg.num_hidden_layers)]
         if all(sliding):
             windowed = True
-        else:
-            _check(not any(sliding),
-                   "mixed per-layer sliding/full attention "
-                   "(qwen2 layer_types / max_window_layers)")
+        elif any(sliding):
+            per_layer = [window if s else None for s in sliding]
     attn_window = window if windowed else None
     if attn_window is not None and attn_window >= max_len:
         attn_window = None  # window never binds — plain causal attention
+    if per_layer is not None:
+        per_layer = [None if (w is not None and w >= max_len) else w
+                     for w in per_layer]
+        attn_window = (per_layer if any(w is not None for w in per_layer)
+                       else None)
     # qwen2: q/k/v carry biases, o does not — zero-filling bo keeps the
     # math identical under our all-or-nothing attn_bias knob.
     qkv_bias = family == "qwen2" or getattr(cfg, "attention_bias", False)
@@ -156,14 +178,14 @@ def _from_llama_family(cfg, sd, family: str
     )
     pre = "model."
     params: Dict[str, Any] = {
-        "tok": _np(sd[pre + "embed_tokens.weight"]),
-        "lnf_s": _np(sd[pre + "norm.weight"]),
+        "tok": _take(sd, pre + "embed_tokens.weight"),
+        "lnf_s": _take(sd, pre + "norm.weight"),
     }
     if not tie:
-        params["head"] = np.ascontiguousarray(_np(sd["lm_head.weight"]).T)
+        params["head"] = np.ascontiguousarray(_take(sd, "lm_head.weight").T)
 
     def stack(fmt, transpose=False):
-        mats = [_np(sd[pre + fmt.format(i)]) for i in range(L)]
+        mats = [_take(sd, pre + fmt.format(i)) for i in range(L)]
         if transpose:  # nn.Linear stores [out, in]
             mats = [m.T for m in mats]
         return np.ascontiguousarray(np.stack(mats))
@@ -227,21 +249,21 @@ def _from_mixtral(cfg, sd) -> Tuple[TransformerLM, Dict[str, np.ndarray]]:
     )
     pre = "model."
     params: Dict[str, Any] = {
-        "tok": _np(sd[pre + "embed_tokens.weight"]),
-        "lnf_s": _np(sd[pre + "norm.weight"]),
+        "tok": _take(sd, pre + "embed_tokens.weight"),
+        "lnf_s": _take(sd, pre + "norm.weight"),
     }
     if not model.tie_embeddings:
-        params["head"] = np.ascontiguousarray(_np(sd["lm_head.weight"]).T)
+        params["head"] = np.ascontiguousarray(_take(sd, "lm_head.weight").T)
 
     def stack(fmt, transpose=False):
-        mats = [_np(sd[pre + fmt.format(i)]) for i in range(L)]
+        mats = [_take(sd, pre + fmt.format(i)) for i in range(L)]
         if transpose:
             mats = [m.T for m in mats]
         return np.ascontiguousarray(np.stack(mats))
 
     def estack(fmt):  # [L, E, in, out] from per-expert [out, in] Linears
         return np.ascontiguousarray(np.stack([
-            np.stack([_np(sd[pre + fmt.format(i, e)]).T for e in range(E)])
+            np.stack([_take(sd, pre + fmt.format(i, e)).T for e in range(E)])
             for i in range(L)
         ]))
 
@@ -267,8 +289,16 @@ def lm_from_hf(hf_model, compute_dtype: str = "float32"
     params; ``model`` carries the architecture resolved from the HF config
     with ``compute_dtype`` applied (use ``"bfloat16"`` on TPU).
     """
-    cfg = hf_model.config
-    sd = hf_model.state_dict()
+    return _convert(hf_model.config, hf_model.state_dict(),
+                    compute_dtype=compute_dtype)
+
+
+def _convert(cfg, sd, compute_dtype: str
+             ) -> Tuple[TransformerLM, Dict[str, np.ndarray]]:
+    """Config + state-dict → ``(model, params)``; consumes ``sd`` (pops
+    each tensor as it converts, so a caller that drops its own references
+    first — :func:`load_hf_lm` — never holds torch and numpy copies of the
+    whole checkpoint simultaneously)."""
     family = cfg.model_type
     if family == "gpt2":
         model, params = _from_gpt2(cfg, sd)
@@ -297,12 +327,19 @@ def load_hf_lm(name_or_path: str, compute_dtype: str = "float32", **kwargs
     """``AutoModelForCausalLM.from_pretrained`` → :func:`lm_from_hf`.
 
     ``kwargs`` pass through to ``from_pretrained`` (e.g.
-    ``torch_dtype``); the torch model is freed after conversion.
+    ``torch_dtype``).
+
+    Host-RAM note: the torch module is released BEFORE conversion and
+    each tensor is freed as it converts (see :func:`_take`), so peak host
+    memory is ~one f32 copy of the checkpoint plus the largest single
+    tensor — not torch + numpy coexisting. For very large checkpoints
+    prefer ``torch_dtype="bfloat16"`` (halves the torch-side footprint;
+    conversion still emits f32 numpy).
     """
     from transformers import AutoModelForCausalLM
 
     hf_model = AutoModelForCausalLM.from_pretrained(name_or_path, **kwargs)
-    try:
-        return lm_from_hf(hf_model, compute_dtype=compute_dtype)
-    finally:
-        del hf_model
+    cfg = hf_model.config
+    sd = hf_model.state_dict()
+    del hf_model  # sd now holds the only references; _take frees as it goes
+    return _convert(cfg, sd, compute_dtype=compute_dtype)

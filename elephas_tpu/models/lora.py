@@ -85,6 +85,23 @@ class LoRATensor:
     def __getitem__(self, idx):
         return self.materialize()[idx]
 
+    def reshape(self, *shape):
+        """Leading-dim reshapes stay LAZY (the mixed-window period scans
+        reshape ``[L, ...]`` stacks to ``[L/p, p, ...]``); anything that
+        touches the trailing matmul dims materializes."""
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        if (len(shape) >= 2 and tuple(shape[-2:]) == tuple(self.w.shape[-2:])
+                and int(np.prod(shape)) == int(np.prod(self.w.shape))):
+            lead = tuple(shape[:-2])
+            return LoRATensor(
+                self.w.reshape(lead + tuple(self.w.shape[-2:])),
+                self.a.reshape(lead + tuple(self.a.shape[-2:])),
+                self.b.reshape(lead + tuple(self.b.shape[-2:])),
+                self.alpha,
+            )
+        return self.materialize().reshape(shape)
+
 
 DEFAULT_LORA_KEYS = ("wq", "wv")
 
@@ -202,7 +219,8 @@ def load_lora(path: str, base_params: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def build_lora_lm_train_step(model: TransformerLM, mesh: Mesh, optimizer,
-                             attn: str = "ring"):
+                             attn: str = "ring",
+                             vocab_block: Optional[int] = None):
     """Compile a dp×sp fine-tuning step over a LoRA-adapted params dict.
 
     Like :func:`~elephas_tpu.models.transformer.build_lm_train_step` but
@@ -215,9 +233,18 @@ def build_lora_lm_train_step(model: TransformerLM, mesh: Mesh, optimizer,
     factors (no full-model moment buffers for frozen weights) and
     decay-style optimizers cannot touch the base; non-adapter gradients
     are zeroed before the update as well.
+
+    ``vocab_block`` streams the loss head in vocab-column chunks
+    (``chunked_summed_xent``) so the ``[B, T, V]`` logits and log-probs
+    never materialize — the fine-tuning memory lever for the V = 32k–152k
+    imported checkpoints LoRA most often targets.
     """
     import optax
-    from .transformer import _check_seq_len, _validate_lm_step
+    from .transformer import (
+        _check_seq_len,
+        _validate_lm_step,
+        chunked_summed_xent,
+    )
 
     if not model._supports_speculative:  # reuse the dense-family marker
         raise NotImplementedError(
@@ -238,6 +265,14 @@ def build_lora_lm_train_step(model: TransformerLM, mesh: Mesh, optimizer,
             ntok_total = float(tokens.shape[0] * tokens.shape[1] * dp * sp)
 
             def loss_fn(p):
+                if vocab_block is not None:
+                    h, _ = model.apply_hidden(p, tokens, positions,
+                                              attn=attn)
+                    w = model.head_weight(p)
+                    if isinstance(w, LoRATensor):  # untied adapted head
+                        w = w.materialize()
+                    ce = chunked_summed_xent(h, w, targets, vocab_block)
+                    return ce / ntok_total
                 logits = model.apply(p, tokens, positions, attn=attn)
                 logp = jax.nn.log_softmax(logits, axis=-1)
                 ll = jnp.take_along_axis(

@@ -92,6 +92,28 @@ class QuantizedTensor:
         # row-scaled (embedding) layout: gather rows, then scale only them
         return self.q[idx].astype(jnp.float32) * self.s[idx]
 
+    def reshape(self, *shape):
+        """Reshapes that only regroup the LEADING dim stay QUANTIZED (the
+        mixed-window period scans reshape ``[L, ...]`` stacks to
+        ``[L/p, p, ...]``; ``s`` keeps ``q``'s rank, so its leading dim —
+        per-layer scales or a broadcast 1 — regroups consistently);
+        anything else dequantizes first."""
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        qs = tuple(self.q.shape)
+        rest = qs[1:]
+        if (len(shape) > len(rest) and tuple(shape[-len(rest):]) == rest
+                and int(np.prod(shape)) == int(np.prod(qs))):
+            lead = tuple(shape[:len(shape) - len(rest)])
+            s_lead = (lead if self.s.shape[0] == qs[0]
+                      else (1,) * len(lead))
+            return QuantizedTensor(
+                self.q.reshape(shape),
+                self.s.reshape(s_lead + tuple(self.s.shape[1:])),
+                self.row_scaled,
+            )
+        return self.dequantize().reshape(shape)
+
 
 def quantize_lm_params(params: Dict[str, Any]) -> Dict[str, Any]:
     """Quantize a (dense-family) LM param dict for inference.

@@ -101,7 +101,8 @@ def build_lm_generate(model: TransformerLM, mesh: Mesh,
                         f"sharded generate shards over {SEQ_AXIS!r}; param "
                         f"{name!r} has spec {spec}"
                     )
-    if model.attn_window is not None:
+    if model.attn_window is not None or getattr(model, "mixed_window",
+                                                False):
         # The per-rank flash-decode partials + lse merge are window-ready
         # (decode_attention_lse takes a window), but the owner-rank cache
         # write logic below does not yet skip fully-expired ranks; guard

@@ -83,6 +83,12 @@ def _validate_tp(model: TransformerLM, mesh: Mesh) -> int:
             "MoE variant shards its experts over the seq axis instead "
             "(build_lm_train_step)"
         )
+    if getattr(model, "mixed_window", False):
+        raise NotImplementedError(
+            "per-layer (mixed) attn_window models are single-device only "
+            "for now: the tp builders assume one model-wide window for "
+            "their ring-cache sizing and masks"
+        )
     if DATA_AXIS not in mesh.shape or TP_AXIS not in mesh.shape:
         raise ValueError(
             f"mesh must carry ({DATA_AXIS!r}, {TP_AXIS!r}) axes, got "
