@@ -1,6 +1,14 @@
 from .adapters import KerasModelAdapter
 from .beam import generate_beam
+from .fsdp_lm import LMFsdpLayout, build_lm_fsdp_train_step
 from .hf_import import lm_from_hf, load_hf_lm
+from .moe_tp import (
+    build_moe_lm_tp_generate,
+    build_moe_lm_tp_train_step,
+    moe_tp_specs,
+    shard_moe_tp_params,
+)
+from .pipeline_lm import build_lm_pp_train_step, lm_pp_specs
 from .losses import resolve_accuracy, resolve_per_sample_loss
 from .optimizers import adam_compact, scale_by_adam_compact, to_optax
 from .lora import (
@@ -34,12 +42,21 @@ from .transformer import (
     build_lm_eval_step,
     build_lm_train_step,
     build_mesh_sp,
+    chunked_summed_xent,
     make_lm_batches,
     select_tokens,
     shard_lm_batch,
 )
 
 __all__ = [
+    "LMFsdpLayout",
+    "build_lm_fsdp_train_step",
+    "build_lm_pp_train_step",
+    "lm_pp_specs",
+    "build_moe_lm_tp_generate",
+    "build_moe_lm_tp_train_step",
+    "moe_tp_specs",
+    "shard_moe_tp_params",
     "LoRATensor",
     "apply_lora",
     "build_lora_lm_train_step",
@@ -74,6 +91,7 @@ __all__ = [
     "build_mesh_sp",
     "build_lm_train_step",
     "build_lm_eval_step",
+    "chunked_summed_xent",
     "make_lm_batches",
     "shard_lm_batch",
 ]

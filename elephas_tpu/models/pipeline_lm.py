@@ -1,0 +1,178 @@
+"""Pipeline-parallel :class:`TransformerLM` training (dp×pp).
+
+EXTENSION BEYOND THE REFERENCE (SURVEY.md §2.3: pipeline parallelism
+"explicitly ABSENT"). ``parallel/pipeline.py`` ships the generic GPipe
+ring (``pipeline_apply``: microbatches hop stages via ``ppermute``; the
+backward pass is the reverse pipeline because XLA transposes the scan +
+ppermute); its stage contract is shape-homogeneous ``[mb, ...] ->
+[mb, ...]`` — and transformer blocks are exactly that
+(``[mb, T, D] -> [mb, T, D]``), so LM DEPTH shards the same way width
+(``models/tensor_lm.py``) and state (``models/fsdp_lm.py``) already do.
+
+Layout: the ``[L, ...]`` stacked block params shard their leading axis
+over ``"pipe"`` — rank ``r`` owns layers ``[r·G, (r+1)·G)`` (G =
+``n_layers / pipe``), applied as a ``lax.scan`` inside its stage tick.
+Embeddings, final norm, and the logits head replicate (every rank
+computes them; the loss is masked to the LAST pipe rank and their
+gradients are restored to the replicated invariant with one pipe-axis
+``psum`` — the ``build_staged_train_step`` convention). The batch axis
+composes as usual: one ``shard_map`` program, batch over ``"data"``,
+stages over ``"pipe"``.
+
+Positions must be row-uniform (every batch row carries the same position
+vector — what ``make_lm_batches`` produces): all microbatches then share
+one RoPE table, which is closure-captured instead of hopping the ring
+with the activations.
+
+GPipe over batch rows is mathematically exact for the dense LM (rows are
+independent through attention; the loss is a token sum), so the 3-step
+trajectory equals the unpipelined oracle to float tolerance
+(``tests/models/test_pipeline_lm.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.mesh import DATA_AXIS
+from ..parallel.param_utils import make_opt_init, opt_state_specs
+from ..parallel.pipeline import PIPE_AXIS, build_mesh_pp, pipeline_apply
+from .transformer import (
+    SEQ_AXIS,
+    TransformerLM,
+    _summed_xent,
+    chunked_summed_xent,
+    is_tpu_backend,
+)
+
+__all__ = ["build_lm_pp_train_step", "build_mesh_pp"]
+
+
+def build_lm_pp_train_step(model: TransformerLM, mesh: Mesh, optimizer,
+                           n_micro: int, attn: str = "flash",
+                           vocab_block: Optional[int] = None):
+    """Compile one dp×pp LM training step.
+
+    ``mesh`` must carry ``("data", "pipe")``; ``model.n_layers`` must
+    divide by the pipe size (one contiguous group of layers per stage).
+    ``n_micro`` microbatches stream the ring — bubble fraction
+    ``(P-1)/(M+P-1)``, so choose ``n_micro >> pipe``. ``attn`` is
+    ``"flash"`` or ``"dense"`` (the sequence stays whole; sp composes via
+    a separate mesh, not here). ``vocab_block`` streams the loss head
+    (``chunked_summed_xent``).
+
+    Returns ``(step, opt_init)`` with the ``build_lm_train_step``
+    contract: ``step(params, opt_state, tokens, positions, targets)``,
+    int arrays ``[B, T]`` sharded over ``"data"`` only, params per
+    :func:`lm_pp_specs` (block stacks over ``"pipe"``, the rest
+    replicated), ``loss`` = global token-mean CE.
+    """
+    if getattr(model, "n_experts", None):
+        raise NotImplementedError(
+            "dp×pp covers the dense TransformerLM family; MoE experts "
+            "shard over the seq axis (build_lm_train_step) instead"
+        )
+    if attn not in ("dense", "flash"):
+        raise ValueError(
+            f"attn={attn!r}: the pipelined LM keeps sequences whole — "
+            "use 'flash' (TPU) or 'dense'"
+        )
+    pp = mesh.shape[PIPE_AXIS]
+    dp = mesh.shape[DATA_AXIS]
+    if model.n_layers % pp:
+        raise ValueError(
+            f"n_layers {model.n_layers} not divisible by pipe axis {pp}"
+        )
+    if n_micro < 1:
+        raise ValueError(f"n_micro must be >= 1, got {n_micro}")
+
+    block_keys = set(model._block_keys())
+    pspecs = {k: P(PIPE_AXIS) if k in block_keys else P()
+              for k in model.param_shapes()}
+    sspecs = opt_state_specs(optimizer, model.param_shapes(), pspecs)
+    tok_spec = P(DATA_AXIS)
+
+    def step_impl(params, opt_state, tokens, positions, targets):
+        prank = jax.lax.axis_index(PIPE_AXIS)
+        ntok_total = float(tokens.shape[0] * tokens.shape[1] * dp)
+        B = tokens.shape[0]
+        if B % n_micro:
+            raise ValueError(
+                f"local batch {B} not divisible by n_micro={n_micro}")
+        mb = B // n_micro
+
+        def loss_fn(p):
+            h = model._embed(p, tokens, positions)
+            rope = model._rope_for(positions)
+            # row-uniform positions ⇒ every microbatch shares the first
+            # mb rows' table (the documented contract)
+            rope_mb = None if rope is None else (rope[0][:mb],
+                                                 rope[1][:mb])
+            tables = None
+            if rope_mb is not None and attn == "flash" and is_tpu_backend():
+                from ..ops.pallas_flash import make_rope_tables
+
+                cos, sin = rope_mb
+                tables = make_rope_tables(cos[..., 0, :], sin[..., 0, :])
+
+            def attend(q, k, v, rp=None):
+                return model._attend(q, k, v, attn, SEQ_AXIS, rope=rp,
+                                     rope_tables=tables)
+
+            def stage_fn(stage_params, x):
+                def one(hh, lp):
+                    hh, _, _, _ = model._block_fwd(
+                        hh, lp, attend, attn, SEQ_AXIS, rope=rope_mb)
+                    return hh, None
+
+                out, _ = jax.lax.scan(one, x, stage_params)
+                return out
+
+            lp_stage = {k: p[k] for k in block_keys}  # local [G, ...]
+            h = pipeline_apply(stage_fn, lp_stage, h, n_micro)
+            h = model._norm_h(p, "lnf", h)
+            if vocab_block is not None:
+                ce = chunked_summed_xent(h, model.head_weight(p), targets,
+                                         vocab_block)
+            else:
+                ce = _summed_xent(model._logits(p, h), targets)
+            # count the pipe-replicated loss once: mask to the last rank
+            return jnp.where(prank == pp - 1, ce / ntok_total, 0.0)
+
+        objective, grads = jax.value_and_grad(loss_fn)(params)
+        # stage params are pipe-OWNED (the reverse pipeline delivered their
+        # cotangents locally); replicated params need the pipe psum to
+        # restore the identical-across-ranks invariant.
+        grads = {
+            k: jax.lax.psum(
+                g if k in block_keys else jax.lax.psum(g, PIPE_AXIS),
+                DATA_AXIS,
+            )
+            for k, g in grads.items()
+        }
+        loss = jax.lax.psum(jax.lax.psum(objective, PIPE_AXIS), DATA_AXIS)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(jnp.add, params, updates)
+        return params, opt_state, loss
+
+    step = jax.jit(
+        jax.shard_map(
+            step_impl, mesh=mesh,
+            in_specs=(pspecs, sspecs, tok_spec, tok_spec, tok_spec),
+            out_specs=(pspecs, sspecs, P()),
+            check_vma=False,
+        ),
+        donate_argnums=(0, 1),
+    )
+    return step, make_opt_init(optimizer, mesh, sspecs)
+
+
+def lm_pp_specs(model: TransformerLM):
+    """PartitionSpecs for the dp×pp layout (block stacks over ``"pipe"``)."""
+    block_keys = set(model._block_keys())
+    return {k: P(PIPE_AXIS) if k in block_keys else P()
+            for k in model.param_shapes()}

@@ -200,7 +200,11 @@ def test_mistral_greedy_generation_parity(tokens):
     _assert_greedy_parity(model, params, hf, tokens)
 
 
-def test_qwen2_mixed_sliding_layers_rejected():
+def test_qwen2_mixed_sliding_layers_import_parity():
+    """Qwen2 with max_window_layers windows only SOME layers — imported
+    as a PER-LAYER attn_window list; logits parity with the window
+    BINDING (T=24 > window=8) validates the mixed-window stack against
+    torch's own per-layer masks."""
     torch.manual_seed(7)
     cfg = transformers.Qwen2Config(
         vocab_size=97, hidden_size=32, intermediate_size=48,
@@ -211,8 +215,31 @@ def test_qwen2_mixed_sliding_layers_rejected():
     )
     hf = transformers.Qwen2ForCausalLM(cfg)
     hf.eval()
-    with pytest.raises(NotImplementedError, match="sliding"):
-        lm_from_hf(hf)
+    model, params = lm_from_hf(hf)
+    assert model.mixed_window
+    assert model.attn_windows == (None, 8)  # layer 0 full, layer 1 slides
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 97, size=(2, 24)).astype(np.int32)
+    _assert_logits_close(model, params, hf, toks)
+
+
+def test_qwen2_mixed_sliding_greedy_generation_parity():
+    """Mixed-window decode (linear cache, per-layer masks) must match
+    HF generate token-for-token past the window boundary."""
+    torch.manual_seed(7)
+    cfg = transformers.Qwen2Config(
+        vocab_size=97, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, use_sliding_window=True,
+        sliding_window=6, max_window_layers=1, attention_dropout=0.0,
+        attn_implementation="eager",
+    )
+    hf = transformers.Qwen2ForCausalLM(cfg)
+    hf.eval()
+    model, params = lm_from_hf(hf)
+    rng = np.random.default_rng(1)
+    toks = rng.integers(0, 97, size=(2, 9)).astype(np.int32)
+    _assert_greedy_parity(model, params, hf, toks, n_new=8)
 
 
 def test_qwen2_default_no_sliding_imports_full_attention(tokens):

@@ -86,6 +86,29 @@ def test_greedy_speculative_with_self_draft():
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.parametrize("batch", [1, 3])
+def test_device_loop_equals_host_oracle(batch):
+    """The compiled while_loop rollout must reproduce the host-driver
+    oracle token-for-token AND stat-for-stat (rounds/proposed/accepted),
+    batch-1 and batched, rejecting draft included."""
+    target = _model(pos_encoding="rotary", n_kv_heads=2)
+    t_params = _params(target, 1)
+    draft = _model(d_model=8, n_heads=2, n_layers=1, d_ff=16,
+                   pos_encoding="rotary")
+    d_params = _params(draft, 99)
+    prompt = np.tile(np.array([[5, 6, 7]], np.int32), (batch, 1))
+    prompt[:, 0] = np.arange(batch) + 3  # distinct rows
+    want, w_stats = target.generate_speculative(
+        t_params, prompt, n_new=12, draft=draft, draft_params=d_params,
+        spec_k=3, with_stats=True, host_loop=True)
+    got, g_stats = target.generate_speculative(
+        t_params, prompt, n_new=12, draft=draft, draft_params=d_params,
+        spec_k=3, with_stats=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    for key in ("proposed", "accepted", "tokens_emitted"):
+        assert g_stats[key] == w_stats[key], (key, g_stats, w_stats)
+
+
 def test_sampled_speculative_valid_and_deterministic():
     target = _model()
     t_params = _params(target, 3)
@@ -134,7 +157,7 @@ def test_self_draft_leaves_no_cache_holes():
         spec_k, n_new = 4, 15
         got = np.asarray(target.generate_speculative(
             t_params, prompt, n_new=n_new, draft=target,
-            draft_params=t_params, spec_k=spec_k,
+            draft_params=t_params, spec_k=spec_k, host_loop=True,
         ))
     finally:
         TransformerLM.decode_chunk = orig_chunk
@@ -143,6 +166,14 @@ def test_self_draft_leaves_no_cache_holes():
     np.testing.assert_array_equal(got, want)
     # ceil(n_new-1 tokens after the first carry / (spec_k+1)) rounds
     assert calls["n"] == -(-(n_new - 1) // (spec_k + 1))
+    # the compiled device loop must show the same perfect-acceptance
+    # round count through its stats (its decode_chunk traces once, so
+    # the call counter above cannot see its rounds)
+    _, stats = target.generate_speculative(
+        t_params, prompt, n_new=n_new, draft=target,
+        draft_params=t_params, spec_k=spec_k, with_stats=True,
+    )
+    assert stats["rounds"] == -(-(n_new - 1) // (spec_k + 1)), stats
 
 
 def test_moe_rejected():
