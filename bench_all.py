@@ -403,12 +403,14 @@ def config7_speculative():
       (sequential target passes saved), dispatch-environment-independent;
     - measured wall tokens/sec for plain cached decode vs speculative.
 
-    On this rig the relay imposes a per-dispatch floor and the speculative
-    loop is host-driven (spec_k+1 dispatches per round vs ONE compiled
-    scan for plain decode), so the WALL ratio here understates on-chip
-    speedup — seq_pass_reduction and acceptance are the portable numbers
-    (same caveat discipline as docs/PERFORMANCE.md's flash-decode entry).
-    TPU-gated (BENCH_ALL_SPEC=1 forces).
+    Since round 4 the greedy round loop is ONE compiled while_loop
+    (``_spec_rollout_device``): dispatches per emitted token < 1, so wall
+    clock measures the on-chip trade directly. Two wall cells: the small
+    trained pair (d512 target — launch-bound decode, where speculation
+    buys little by construction) and a SERVING-SCALE pair (d2048/L8
+    target, the judged-LM geometry, whose decode step is weight-bandwidth
+    bound — the regime speculative decoding exists for). TPU-gated
+    (BENCH_ALL_SPEC=1 forces).
     """
     import jax
     import numpy as np
@@ -445,9 +447,9 @@ def config7_speculative():
 
     mesh = build_mesh_sp(data=1, seq=1)
 
-    def train(model, seed, n_steps):
+    def train(model, seed, n_steps, lr=3e-3):
         step, opt_init = build_lm_train_step(
-            model, mesh, optax.adam(3e-3), attn="flash")
+            model, mesh, optax.adam(lr), attn="flash")
         params = model.shard_params(mesh, model.init(seed=seed))
         state = opt_init(params)
         loss = None
@@ -529,6 +531,71 @@ def config7_speculative():
         f"wall {out['plain_tokens_per_sec']:.0f} -> "
         f"{out['spec_tokens_per_sec']:.0f} tok/s "
         f"(x{out['wall_speedup']}), match={agree}")
+
+    # -- serving-scale cell: big (weight-bandwidth-bound) target ----------
+    # d2048/L8 needs ~300 adam(1e-3) steps to learn the Markov language
+    # (loss ~0.9; an undertrained target disagrees with ANY draft and
+    # acceptance collapses). Wall clock is measured two ways: raw at
+    # n_big tokens, and MARGINAL (differencing 64- and n_big-token
+    # rollouts) so the ~100 ms per-call relay overhead cancels — the same
+    # honest-metric discipline as the judged MNIST figure.
+    big_steps = int(os.environ.get("BENCH_ALL_SPEC_BIG_STEPS", 300))
+    n_big = int(os.environ.get("BENCH_ALL_SPEC_BIG_NEW", 512))
+    bh = 64 + n_big + spec_k + 2
+    big = TransformerLM(vocab=V, d_model=2048, n_heads=8, n_layers=8,
+                        d_ff=8192, max_len=max(T, bh),
+                        compute_dtype="bfloat16", pos_encoding="rotary",
+                        tie_embeddings=True)
+    bdraft = TransformerLM(vocab=V, d_model=256, n_heads=2, n_layers=2,
+                           d_ff=1024, max_len=max(T, bh),
+                           compute_dtype="bfloat16", pos_encoding="rotary")
+    b_params = train(big, 2, big_steps, lr=1e-3)
+    bd_params = train(bdraft, 3, max(big_steps // 3, 1))
+
+    def best_wall(fn):
+        best, result = float("inf"), None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            result = np.asarray(fn())
+            best = min(best, time.perf_counter() - t0)
+        return best, result
+
+    t_plain_64, _ = best_wall(lambda: big.generate(b_params, prompt, 64))
+    tb_plain, bplain = best_wall(
+        lambda: big.generate(b_params, prompt, n_big))
+    t_spec_64, _ = best_wall(lambda: big.generate_speculative(
+        b_params, prompt, 64, bdraft, bd_params, spec_k=spec_k))
+    tb_spec, bspec = best_wall(lambda: big.generate_speculative(
+        b_params, prompt, n_big, bdraft, bd_params, spec_k=spec_k))
+    _, bstats = big.generate_speculative(
+        b_params, prompt, n_big, bdraft, bd_params, spec_k=spec_k,
+        with_stats=True)
+    bagree = bool((np.asarray(bspec) == bplain).all())
+    marg = n_big - 64
+    m_plain = (tb_plain - t_plain_64) / marg * 1e3  # ms/token
+    m_spec = (tb_spec - t_spec_64) / marg * 1e3
+    out["serving_scale"] = {
+        "target": "d2048xL8xF8192-bf16",
+        "draft": "d256xL2xF1024-bf16",
+        "n_new": n_big,
+        "acceptance_rate_greedy": round(bstats["acceptance_rate"], 4),
+        "rounds": bstats["rounds"],
+        "plain_tokens_per_sec": round(n_big / tb_plain, 1),
+        "spec_tokens_per_sec": round(n_big / tb_spec, 1),
+        "wall_speedup": round(tb_plain / tb_spec, 3),
+        "marginal_ms_per_token_plain": round(m_plain, 3),
+        "marginal_ms_per_token_spec": round(m_spec, 3),
+        "marginal_wall_speedup": (
+            round(m_plain / m_spec, 2) if m_spec > 0 else None),
+        "greedy_output_matches_target": bagree,
+    }
+    s = out["serving_scale"]
+    log(f"config7 serving-scale: acceptance "
+        f"{s['acceptance_rate_greedy']:.2%}, wall "
+        f"{s['plain_tokens_per_sec']:.0f} -> "
+        f"{s['spec_tokens_per_sec']:.0f} tok/s (x{s['wall_speedup']}); "
+        f"marginal {m_plain:.2f} -> {m_spec:.2f} ms/tok "
+        f"(x{s['marginal_wall_speedup']}), match={bagree}")
     return out
 
 
@@ -539,10 +606,10 @@ def config8_moe_lm():
     shards them — ``dryrun_multichip``), so this measures the routing
     machinery's single-chip cost: tokens/sec and an MFU whose denominator
     counts MODEL FLOPs only (attention + router + the k ACTIVE experts per
-    token, swiglu-aware) — the GShard dispatch/combine einsums are counted
-    as OVERHEAD, not useful FLOPs, so the gap between this MFU and the
-    dense LM's at equal active FLOPs IS the price of routing. TPU-gated
-    (BENCH_ALL_MOE=1 forces).
+    token, swiglu-aware) — dispatch (index-form slot gather since round 4;
+    see docs/PERFORMANCE.md config 8) is counted as OVERHEAD, not useful
+    FLOPs, so the gap between this MFU and the dense LM's at equal active
+    FLOPs IS the price of routing. TPU-gated (BENCH_ALL_MOE=1 forces).
     """
     import jax
     import numpy as np
@@ -616,6 +683,81 @@ def config8_moe_lm():
     }
 
 
+def config9_large_vocab_lm():
+    """V=32k LM: the vocab-chunked loss head vs the dense head.
+
+    The imported-checkpoint vocabs (32k–152k) make the ``[B, T, V]``
+    logits + cotangent the peak-memory term of a fine-tuning step.
+    ``vocab_block`` streams the head (online-lse forward, per-block
+    recompute backward; ``chunked_summed_xent``) — this config measures
+    BOTH step time and XLA's compiled temp-memory budget for the two
+    paths at d1024/L4/V32768/T2048/B4 bf16. TPU-gated
+    (BENCH_ALL_VOCAB=1 forces).
+    """
+    import jax
+    import numpy as np
+
+    gate = os.environ.get("BENCH_ALL_VOCAB", "auto")
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if gate == "0" or (gate == "auto" and not on_tpu):
+        log("config9 vocab: skipped (not on TPU; BENCH_ALL_VOCAB=1 forces)")
+        return {"skipped": "not on TPU"}
+
+    from elephas_tpu.models import (
+        TransformerLM, adam_compact, build_lm_train_step, build_mesh_sp,
+        make_lm_batches, shard_lm_batch,
+    )
+
+    D, L, H, F, V, T, B = 1024, 4, 8, 4096, 32768, 2048, 4
+    steps = 8
+    out = {}
+    for label, vocab_block in (("dense_head", None), ("chunked_head", 8192)):
+        model = TransformerLM(
+            vocab=V, d_model=D, n_heads=H, n_layers=L, d_ff=F, max_len=T,
+            compute_dtype="bfloat16", pos_encoding="rotary",
+            tie_embeddings=True, activation="swiglu", norm="rmsnorm",
+            ffn_bias=False,
+        )
+        mesh = build_mesh_sp(data=1, seq=1)
+        step, opt_init = build_lm_train_step(
+            model, mesh, adam_compact(1e-3), attn="flash",
+            vocab_block=vocab_block)
+        params = model.shard_params(mesh, model.init(seed=0))
+        state = opt_init(params)
+        rows = np.random.default_rng(0).integers(0, V, size=(B, T + 1))
+        batch = shard_lm_batch(mesh, *make_lm_batches(rows))
+        temp_gb = None
+        try:  # compiled temp budget — the memory claim, measured by XLA
+            target = next(v for c in (step.__closure__ or [])
+                          for v in [c.cell_contents] if hasattr(v, "lower"))
+            compiled = target.lower(params, state, *batch).compile()
+            temp_gb = compiled.memory_analysis().temp_size_in_bytes / 1e9
+        except Exception as e:
+            log(f"config9: memory_analysis unavailable ({e})")
+        for _ in range(2):
+            params, state, loss = step(params, state, *batch)
+        float(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            params, state, loss = step(params, state, *batch)
+        last = float(loss)
+        dt = (time.perf_counter() - t0) / steps
+        assert np.isfinite(last), last
+        out[label] = {
+            "tokens_per_sec": round(B * T / dt, 1),
+            "step_ms": round(dt * 1e3, 2),
+            "xla_temp_gb": round(temp_gb, 2) if temp_gb else None,
+        }
+        log(f"config9 {label}: {B * T / dt:,.0f} tok/s, "
+            f"{dt * 1e3:.1f} ms/step, temp {temp_gb and round(temp_gb, 2)} GB")
+    d, c = out["dense_head"], out["chunked_head"]
+    if d["xla_temp_gb"] and c["xla_temp_gb"]:
+        out["temp_memory_saved_gb"] = round(
+            d["xla_temp_gb"] - c["xla_temp_gb"], 2)
+    out["config"] = f"d{D}xL{L}xV{V}xT{T}xB{B}-swiglu-bf16"
+    return out
+
+
 def main():
     from harness_env import cpu_mesh_env, probe_backend
 
@@ -637,6 +779,7 @@ def main():
         ("conv_mfu", config6_conv_mfu),
         ("speculative", config7_speculative),
         ("moe_lm", config8_moe_lm),
+        ("large_vocab_lm", config9_large_vocab_lm),
     ):
         try:
             results[name] = fn()

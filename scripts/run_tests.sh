@@ -1,58 +1,115 @@
 #!/usr/bin/env bash
-# Suite runner with hang recovery.
+# Suite runner with hang AND crash recovery.
 #
-# tests/conftest.py arms a per-test watchdog: a test that exceeds its bound
-# (ELEPHAS_TEST_TIMEOUT; see conftest for the default and how it was sized)
-# gets every thread's stack dumped, its
-# nodeid written to ELEPHAS_WATCHDOG_FILE, and the process killed with exit
-# 42 — a wedged XLA CPU collective cannot be interrupted from Python, so the
-# process is the unit of recovery. This wrapper turns that into a retry:
+# The suite runs in SHARDS (one pytest process per top-level tests/
+# directory). Two reasons:
 #
-#   exit 42, first time for a nodeid  -> rerun the suite (the hung test gets
-#                                        a second chance in a fresh process)
-#   exit 42, same nodeid twice        -> deselect it, keep running the rest,
-#                                        mark the job failed
-#   any other exit                    -> passed through unchanged
+# 1. tests/conftest.py arms a per-test watchdog: a test that exceeds its
+#    bound (ELEPHAS_TEST_TIMEOUT) gets every thread's stack dumped, its
+#    nodeid written to ELEPHAS_WATCHDOG_FILE, and the process killed with
+#    exit 42 — a wedged XLA CPU collective cannot be interrupted from
+#    Python, so the process is the unit of recovery. This wrapper turns
+#    that into a retry (rerun the shard; a nodeid that hangs twice is
+#    deselected and the job marked failed).
+# 2. One ~500-test process accumulates an enormous jit cache and compiler
+#    state, under which XLA's CPU backend segfaults rarely but
+#    reproducibly (observed in backend_compile_and_load during a backward
+#    compile; the same test passes in a fresh process). Sharding bounds
+#    per-process state; a shard that CRASHES (segfault/abort) retries once
+#    in a fresh process before failing the job.
 #
-# Environment (test env vars, e.g. JAX_PLATFORMS) must be set by the caller;
-# `make test` does that.
+# Environment (test env vars, e.g. JAX_PLATFORMS) must be set by the
+# caller; `make test` does that.
 set -u
 
 WATCHDOG_FILE="${ELEPHAS_WATCHDOG_FILE:-$(mktemp /tmp/elephas_watchdog.XXXXXX)}"
 export ELEPHAS_WATCHDOG_FILE="$WATCHDOG_FILE"
 
-deselect=()
-hung_once=""
-hung_failed=0
+# Top-level shards: every directory under tests/ plus tests/ itself
+# non-recursively (pytest.ini-style rootdir files).
+shards=()
+for d in tests/*/; do
+  [ -d "$d" ] && [ -n "$(find "$d" -name 'test_*.py' -print -quit)" ] \
+    && shards+=("${d%/}")
+done
+if [ -n "$(find tests -maxdepth 1 -name 'test_*.py' -print -quit)" ]; then
+  shards+=("--top")  # sentinel: tests/ non-recursive
+fi
 
-for attempt in 1 2 3 4; do
-  rm -f "$WATCHDOG_FILE"
-  python -m pytest tests/ "$@" "${deselect[@]}"
-  rc=$?
-  if [ "$rc" -ne 42 ]; then
+overall_rc=0
+
+run_shard() {
+  local shard="$1"; shift
+  local deselect=()
+  local hung_once=""
+  local hung_failed=0
+  local crashed_once=0
+  local target=("$shard")
+  if [ "$shard" == "--top" ]; then
+    target=()
+    for f in tests/test_*.py; do [ -e "$f" ] && target+=("$f"); done
+    [ "${#target[@]}" -eq 0 ] && return 0
+  fi
+
+  for attempt in 1 2 3 4; do
     rm -f "$WATCHDOG_FILE"
-    if [ "$rc" -eq 0 ] && [ "$hung_failed" -ne 0 ]; then
-      echo "[run_tests] suite green but a test hung twice and was deselected — failing"
-      exit 1
+    python -m pytest "${target[@]}" "$@" "${deselect[@]}"
+    rc=$?
+    if [ "$rc" -eq 5 ]; then  # no tests collected in this shard
+      return 0
     fi
-    exit "$rc"
-  fi
-  nodeid="$(head -n1 "$WATCHDOG_FILE" 2>/dev/null || true)"
-  if [ -z "$nodeid" ]; then
-    echo "[run_tests] watchdog exit without a recorded nodeid — giving up"
-    exit 42
-  fi
-  echo "[run_tests] watchdog killed hung test: $nodeid (attempt $attempt)"
-  tail -n +2 "$WATCHDOG_FILE"  # the hung process's all-thread stack dump
-  if [ "$nodeid" == "$hung_once" ]; then
-    echo "[run_tests] $nodeid hung twice — deselecting it and failing the job at the end"
-    deselect+=("--deselect=$nodeid")
-    hung_failed=1
-    hung_once=""
-  else
-    hung_once="$nodeid"
+    if [ "$rc" -ge 128 ]; then  # killed by signal (segfault, abort, …)
+      if [ "$crashed_once" -eq 0 ]; then
+        echo "[run_tests] shard ${target[*]} crashed (rc=$rc) — retrying once in a fresh process"
+        crashed_once=1
+        continue
+      fi
+      echo "[run_tests] shard ${target[*]} crashed twice (rc=$rc) — failing"
+      return "$rc"
+    fi
+    if [ "$rc" -ne 42 ]; then
+      if [ "$rc" -eq 0 ] && [ "$hung_failed" -ne 0 ]; then
+        echo "[run_tests] shard green but a test hung twice and was deselected — failing"
+        return 1
+      fi
+      return "$rc"
+    fi
+    nodeid="$(head -n1 "$WATCHDOG_FILE" 2>/dev/null || true)"
+    if [ -z "$nodeid" ]; then
+      echo "[run_tests] watchdog exit without a recorded nodeid — giving up"
+      return 42
+    fi
+    echo "[run_tests] watchdog killed hung test: $nodeid (attempt $attempt)"
+    tail -n +2 "$WATCHDOG_FILE"  # the hung process's all-thread stack dump
+    if [ "$nodeid" == "$hung_once" ]; then
+      echo "[run_tests] $nodeid hung twice — deselecting it and failing the job at the end"
+      deselect+=("--deselect=$nodeid")
+      hung_failed=1
+      hung_once=""
+    else
+      hung_once="$nodeid"
+    fi
+  done
+
+  echo "[run_tests] too many watchdog kills in shard ${target[*]} — giving up"
+  return 1
+}
+
+for shard in "${shards[@]}"; do
+  run_shard "$shard" "$@"
+  rc=$?
+  if [ "$rc" -ne 0 ]; then
+    overall_rc="$rc"
+    echo "[run_tests] shard $shard FAILED (rc=$rc)"
+    # -x style early exit if the caller asked for it
+    for a in "$@"; do
+      if [ "$a" == "-x" ] || [ "$a" == "--exitfirst" ]; then
+        rm -f "$WATCHDOG_FILE"
+        exit "$rc"
+      fi
+    done
   fi
 done
 
-echo "[run_tests] too many watchdog kills — giving up"
-exit 1
+rm -f "$WATCHDOG_FILE"
+exit "$overall_rc"
