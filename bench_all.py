@@ -630,11 +630,16 @@ def config8_moe_lm():
     E, K = 8, 2
     V, T, B = 8192, 1024, 4
     steps, reps = 10, 3
+    # param_dtype="bfloat16": expert stacks STORED bf16 (router/attention
+    # stay f32; adam math stays f32 via adam_compact upcasts). Kills the
+    # dominant per-step f32→bf16 convert traffic — measured −10.1 ms/step
+    # at this geometry with the loss trajectory matching f32 storage to
+    # 5 decimals at step 2 (docs/PERFORMANCE.md config 8).
     model = MoETransformerLM(
         vocab=V, d_model=D, n_heads=H, n_layers=L, d_ff=F, max_len=T,
         n_experts=E, k=K, capacity_factor=1.25, compute_dtype="bfloat16",
         pos_encoding="rotary", tie_embeddings=True, activation="swiglu",
-        norm="rmsnorm", ffn_bias=False,
+        norm="rmsnorm", ffn_bias=False, param_dtype="bfloat16",
     )
     mesh = build_mesh_sp(data=1, seq=1)
     step, opt_init = build_lm_train_step(model, mesh, adam_compact(1e-3),
@@ -679,7 +684,7 @@ def config8_moe_lm():
         "step_ms": round(best / steps * 1e3, 2),
         "flops_per_token_model_only": round(flops_tok),
         "active_params_per_token_frac": round(K / E, 3),
-        "config": f"d{D}xL{L}xE{E}k{K}xF{F}xT{T}xB{B}-swiglu-bf16",
+        "config": f"d{D}xL{L}xE{E}k{K}xF{F}xT{T}xB{B}-swiglu-bf16-bf16params",
     }
 
 

@@ -612,13 +612,14 @@ class TransformerLM:
         out: Dict[str, np.ndarray] = {}
         for name, sds in self.param_shapes().items():
             if name.startswith(("ln1_s", "ln2_s", "lnf_s")):
-                out[name] = np.ones(sds.shape, np.float32)
+                out[name] = np.ones(sds.shape, sds.dtype)
             elif name.startswith(("ln", "b")):
-                out[name] = np.zeros(sds.shape, np.float32)
+                out[name] = np.zeros(sds.shape, sds.dtype)
             elif name in ("tok", "pos"):
-                out[name] = (rng.normal(size=sds.shape) * 0.02).astype(np.float32)
+                out[name] = (rng.normal(size=sds.shape) * 0.02).astype(
+                    sds.dtype)
             else:
-                out[name] = glorot(rng, *sds.shape)
+                out[name] = glorot(rng, *sds.shape, dtype=sds.dtype)
         return out
 
     def specs(self) -> Dict[str, P]:
@@ -1623,7 +1624,7 @@ class MoETransformerLM(TransformerLM):
                  attn_bias: bool = False, ffn_bias: bool = True,
                  rope_theta: float = 10000.0,
                  attn_window: Optional[int] = None,
-                 moe_dispatch: str = "slots"):
+                 moe_dispatch: str = "slots", param_dtype: str = "float32"):
         # ``activation``/``ffn_bias`` configure the EXPERTS (the MoE block
         # replaces the dense FFN); the remaining knobs hit the attention/
         # norm stack via the base class — together they cover the
@@ -1649,11 +1650,16 @@ class MoETransformerLM(TransformerLM):
                 "'token_choice' here, or MoEFeedForward directly for "
                 "non-causal workloads"
             )
+        # param_dtype="bfloat16" stores the EXPERT stacks (the ~E×3·D·F
+        # bulk of the model) in bf16: use-site casts become no-ops and the
+        # per-step f32→bf16 convert traffic disappears; optimizer math
+        # stays f32 (adam_compact upcasts) with one bf16 rounding per
+        # update. The router and the attention/embedding stack remain f32.
         self.moe = MoEFeedForward(d_model, d_ff, n_experts, k=k,
                                   capacity_factor=capacity_factor,
                                   routing=routing, activation=activation,
-                                  bias=ffn_bias)
-        if moe_dispatch not in ("slots", "ragged", "onehot"):
+                                  bias=ffn_bias, param_dtype=param_dtype)
+        if moe_dispatch not in ("slots", "gmm", "ragged", "onehot"):
             raise ValueError(f"Unknown moe_dispatch: {moe_dispatch!r}")
         self.n_experts = n_experts
         self.aux_weight = aux_weight
@@ -1661,9 +1667,14 @@ class MoETransformerLM(TransformerLM):
         # Single-device FFN executor (routing decisions are identical in
         # all three; only execution strategy differs):
         #   "slots"  (default) — index-form gather dispatch into capacity
-        #            slots (MoEFeedForward.apply_slots; fastest measured
-        #            on TPU: no [N, E, C] products, bf16 expert matmuls,
-        #            gather-only AD transposes);
+        #            slots (MoEFeedForward.apply_slots; no [N, E, C]
+        #            products, bf16 expert matmuls, gather-only AD
+        #            transposes);
+        #   "gmm"    — Pallas tile-aligned grouped matmul (apply_gmm;
+        #            k·N rows + ≤E·128 tile padding, recompute-backward
+        #            swiglu FFN. Fastest kernel standalone, but the slot
+        #            path's XLA-fused dispatch still wins the full train
+        #            step — docs/PERFORMANCE.md config 8);
         #   "ragged" — sort + jax.lax.ragged_dot grouped matmul over
         #            exactly k·N rows (apply_grouped; no capacity padding
         #            — wins where ragged_dot lowers well);
@@ -1705,8 +1716,10 @@ class MoETransformerLM(TransformerLM):
             # group is the whole local block, so the requested
             # single-device executor is exactly equivalent there.
             if jax.lax.axis_size(seq_axis) == 1 and self.moe_dispatch in (
-                    "ragged", "onehot"):
-                if self.moe_dispatch == "ragged":
+                    "gmm", "ragged", "onehot"):
+                if self.moe_dispatch == "gmm":
+                    y, aux = self.moe.apply_gmm(moe_params, flat)
+                elif self.moe_dispatch == "ragged":
                     y, aux = self.moe.apply_grouped(moe_params, flat)
                 else:
                     y, aux = self.moe.apply_reference(moe_params, flat)
@@ -1728,6 +1741,8 @@ class MoETransformerLM(TransformerLM):
         xg = x.reshape(B, G, tl, D).transpose(1, 0, 2, 3).reshape(G * B * tl, D)
         if self.moe_dispatch == "slots":
             y, aux = self.moe.apply_slots(moe_params, xg, ep=G)
+        elif self.moe_dispatch == "gmm":
+            y, aux = self.moe.apply_gmm(moe_params, xg, ep=G)
         elif self.moe_dispatch == "ragged":
             y, aux = self.moe.apply_grouped(moe_params, xg, ep=G)
         else:
@@ -1905,7 +1920,10 @@ def build_lm_train_step(model: TransformerLM, mesh: Mesh, optimizer,
             jax.lax.psum(objective, SEQ_AXIS), DATA_AXIS
         )
         updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = jax.tree_util.tree_map(jnp.add, params, updates)
+        # dtype-preserving apply: bf16-stored params add in f32 (updates
+        # are f32 from the optimizer) and round ONCE; f32 params unchanged
+        params = jax.tree_util.tree_map(
+            lambda p, u: (p + u).astype(p.dtype), params, updates)
         return params, opt_state, loss
 
     jit_step = jax.jit(

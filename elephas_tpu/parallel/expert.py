@@ -29,6 +29,7 @@ axes, expert grads over ``"data"`` only).
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Any, Dict, Optional, Sequence
 
@@ -187,6 +188,72 @@ def _slots_to_rows_bwd(cell, g):
 _slots_to_rows.defvjp(_slots_to_rows_fwd, _slots_to_rows_bwd)
 
 
+def _ffn_mm(xs, w, gmap, use_kernel: bool, interpret: bool,
+            transpose: bool = False):
+    """One grouped projection for :func:`_moe_ffn_swiglu` — Pallas kernel
+    or jnp reference, forward-only (differentiation is hand-written in
+    the caller's VJP)."""
+    from ..ops import grouped_matmul as G
+
+    if use_kernel:
+        fn = G.gmm_t if transpose else G.gmm
+        return fn(xs, w, gmap, interpret)
+    return G.gmm_reference(xs, w, gmap, transpose_rhs=transpose)
+
+
+def _ffn_tgmm(lhs, g, gmap, n_groups: int, dtype, use_kernel: bool,
+              interpret: bool):
+    from ..ops import grouped_matmul as G
+
+    if use_kernel:
+        return G.tgmm(lhs, g, gmap, n_groups, dtype, interpret)
+    return G.tgmm_reference(lhs, g, gmap, n_groups).astype(dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _moe_ffn_swiglu(xs, w1, w2, w3, gmap, use_kernel, interpret):
+    """Grouped swiglu FFN (``out = (silu(xs·w1[g]) ⊙ (xs·w3[g])) · w2[g]``)
+    with a RECOMPUTE backward: residuals are ``(xs, weights, gmap)`` only.
+    Saving ``u``/``v``/``h`` (three ``[M, F]`` tensors per layer) through
+    the layer scan costs more in carry-stacking HBM traffic than the two
+    grouped matmuls that rebuild them (docs/PERFORMANCE.md config 8), and
+    keeping the silu-gradient chain inside one VJP lets XLA fuse it as a
+    single bf16 elementwise region instead of the generic AD graph."""
+    u = _ffn_mm(xs, w1, gmap, use_kernel, interpret)
+    v = _ffn_mm(xs, w3, gmap, use_kernel, interpret)
+    h = jax.nn.silu(u) * v
+    return _ffn_mm(h, w2, gmap, use_kernel, interpret)
+
+
+def _moe_ffn_swiglu_fwd(xs, w1, w2, w3, gmap, use_kernel, interpret):
+    out = _moe_ffn_swiglu(xs, w1, w2, w3, gmap, use_kernel, interpret)
+    return out, (xs, w1, w2, w3, gmap)
+
+
+def _moe_ffn_swiglu_bwd(use_kernel, interpret, res, dout):
+    xs, w1, w2, w3, gmap = res
+    E = w1.shape[0]
+    u = _ffn_mm(xs, w1, gmap, use_kernel, interpret)
+    v = _ffn_mm(xs, w3, gmap, use_kernel, interpret)
+    sig = jax.nn.sigmoid(u)
+    su = u * sig
+    h = su * v
+    dh = _ffn_mm(dout, w2, gmap, use_kernel, interpret, transpose=True)
+    dv = dh * su
+    du = dh * v * (sig + su * (1.0 - sig))  # d silu(u) = σ(u)(1 + u(1-σ))
+    dxs = (
+        _ffn_mm(du, w1, gmap, use_kernel, interpret, transpose=True)
+        + _ffn_mm(dv, w3, gmap, use_kernel, interpret, transpose=True)
+    )
+    dw1 = _ffn_tgmm(xs, du, gmap, E, w1.dtype, use_kernel, interpret)
+    dw3 = _ffn_tgmm(xs, dv, gmap, E, w3.dtype, use_kernel, interpret)
+    dw2 = _ffn_tgmm(h, dout, gmap, E, w2.dtype, use_kernel, interpret)
+    return dxs, dw1, dw2, dw3, None
+
+
+_moe_ffn_swiglu.defvjp(_moe_ffn_swiglu_fwd, _moe_ffn_swiglu_bwd)
+
+
 def _expert_choice_dispatch(gates, capacity: int):
     """Expert-choice routing (Zhou et al. 2022): each EXPERT picks its
     top-``capacity`` tokens by gate score (ties break to the lowest token
@@ -220,7 +287,7 @@ class MoEFeedForward:
     def __init__(self, d_model: int, d_ff: int, n_experts: int, k: int = 2,
                  capacity_factor: float = 1.25,
                  routing: str = "token_choice", activation: str = "relu",
-                 bias: bool = True):
+                 bias: bool = True, param_dtype="float32"):
         if n_experts < k:
             raise ValueError(f"need n_experts >= k, got {n_experts} < {k}")
         if routing not in ("token_choice", "expert_choice"):
@@ -235,20 +302,30 @@ class MoEFeedForward:
         self.routing = routing
         self.activation = activation
         self.bias = bool(bias)
+        # Storage dtype for the EXPERT stacks only. The router (wg) always
+        # stays float32 — routing argmaxes must be bit-stable against the
+        # oracle. bf16 storage kills the dominant per-step convert traffic
+        # (the stacks are the big tensors: E·3·D·F params): the use-site
+        # ``astype(compute_dtype)`` becomes a no-op, and gradients arrive
+        # bf16 (optimizer math still runs f32 — adam_compact upcasts, and
+        # the update add rounds once per step; docs/PERFORMANCE.md
+        # config 8 measures the trade).
+        self.param_dtype = jnp.dtype(param_dtype)
 
     def param_shapes(self) -> Dict[str, jax.ShapeDtypeStruct]:
         """Full (unsharded) shape/dtype per param — the shape-only source for
         :meth:`init` and the train-step builder's optimizer-state specs."""
         E, D, F = self.n_experts, self.d_model, self.d_ff
+        pd = self.param_dtype
         shapes = {
             "wg": jax.ShapeDtypeStruct((D, E), jnp.float32),
-            "w1": jax.ShapeDtypeStruct((E, D, F), jnp.float32),
-            "b1": jax.ShapeDtypeStruct((E, F), jnp.float32),
-            "w2": jax.ShapeDtypeStruct((E, F, D), jnp.float32),
-            "b2": jax.ShapeDtypeStruct((E, D), jnp.float32),
+            "w1": jax.ShapeDtypeStruct((E, D, F), pd),
+            "b1": jax.ShapeDtypeStruct((E, F), pd),
+            "w2": jax.ShapeDtypeStruct((E, F, D), pd),
+            "b2": jax.ShapeDtypeStruct((E, D), pd),
         }
         if self.activation == "swiglu":
-            shapes["w3"] = jax.ShapeDtypeStruct((E, D, F), jnp.float32)
+            shapes["w3"] = jax.ShapeDtypeStruct((E, D, F), pd)
         if not self.bias:
             del shapes["b1"], shapes["b2"]
         return shapes
@@ -512,6 +589,150 @@ class MoEFeedForward:
         ys, c1s, gsums = [], [], []
         for blk in jnp.split(x, ep, axis=0):
             y, c1, gsum = self._grouped_block(params, blk, cap)
+            ys.append(y)
+            c1s.append(c1)
+            gsums.append(gsum)
+        c1, gsum = sum(c1s), sum(gsums)
+        aux = self.n_experts * jnp.sum((c1 / n) * (gsum / n))
+        return jnp.concatenate(ys, axis=0), aux
+
+    def _tile_layout(self, eidx, slot, n: int, tm: int):
+        """Tile-aligned sorted-by-expert row layout for the Pallas grouped
+        matmul: expert ``e``'s (token, choice) pairs occupy contiguous rows
+        ``off[e] + slot`` with ``off`` the exclusive cumsum of per-expert
+        claim counts rounded UP to a multiple of ``tm`` (and at least one
+        tile, so every expert's weight-grad block gets visited/zeroed —
+        the :func:`..ops.grouped_matmul.tgmm` precondition). Static buffer
+        height ``M_pad = k·N + E·tm`` bounds the padding at ``E·tm`` rows
+        — at bench shapes ~6–12 %, vs the capacity path's ``cf−1`` = 25 %.
+
+        Returns ``(row [N, k], inv [M_pad], tok_of_row [M_pad],
+        gmap [M_pad/tm])``: ``row`` maps pair → buffer row (injective),
+        ``inv`` its inverse (sentinel ``N·k`` for padding rows),
+        ``tok_of_row`` the gather index building the buffer (sentinel
+        ``N`` → zero fill), ``gmap`` the non-decreasing tile → expert map
+        the kernels prefetch."""
+        E, k = self.n_experts, self.k
+        sizes = jnp.bincount(eidx.reshape(-1), length=E).astype(jnp.int32)
+        padded = jnp.maximum((sizes + tm - 1) // tm, 1) * tm
+        cum = jnp.cumsum(padded)
+        off = cum - padded
+        row = jnp.take(off, eidx, axis=0) + slot  # [N, k]
+        # Σ padded ≤ k·N + E·tm; the buffer itself must ALSO be a tile
+        # multiple (k·N need not be) or gmap/tile geometry shears.
+        m_pad = -(-(n * k + E * tm) // tm) * tm
+        sent = n * k
+        inv = jnp.full((m_pad,), sent, jnp.int32).at[row.reshape(-1)].set(
+            jnp.arange(sent, dtype=jnp.int32))
+        tok_of_row = jnp.where(inv == sent, n, inv // k)
+        tile_start = jnp.arange(m_pad // tm, dtype=jnp.int32) * tm
+        gmap = jnp.clip(
+            jnp.searchsorted(cum, tile_start, side="right"), 0, E - 1
+        ).astype(jnp.int32)
+        return row, inv, tok_of_row, gmap
+
+    def _gmm_ffn_fused(self, G, params, xs, gmap, use_kernel: bool,
+                       interpret: bool):
+        """The swiglu/bias-free expert FFN as ONE recompute-backward op
+        (:func:`_moe_ffn_swiglu`): only ``xs`` and the weights are saved
+        for the backward — ``u``/``v``/``h`` (the ``[M, F]`` tensors that
+        dominate the layer scan's residual stacking) are recomputed from
+        ``xs`` by two extra grouped matmuls, and the silu gradient chain
+        stays inside one fused elementwise region."""
+        cd = xs.dtype
+        return _moe_ffn_swiglu(
+            xs, params["w1"].astype(cd), params["w2"].astype(cd),
+            params["w3"].astype(cd), gmap, use_kernel, interpret)
+
+    def _gmm_ffn(self, G, params, xs, gmap, tm: int, use_kernel: bool,
+                 interpret: bool):
+        """The three grouped projections over the tile-aligned buffer
+        (kernel or jnp reference — identical math)."""
+        cd = xs.dtype
+
+        def mm(rows, key):
+            return _ffn_mm(rows, params[key].astype(cd), gmap, use_kernel,
+                           bool(interpret))
+
+        u = mm(xs, "w1")
+        if self.bias:
+            e_of_row = jnp.repeat(gmap, tm)
+            u = u + jnp.take(params["b1"].astype(cd), e_of_row, axis=0)
+        if self.activation == "swiglu":
+            h = jax.nn.silu(u) * mm(xs, "w3")
+        elif self.activation == "gelu":
+            h = jax.nn.gelu(u, approximate=True)
+        else:
+            h = jax.nn.relu(u)
+        out = mm(h, "w2")
+        if self.bias:
+            out = out + jnp.take(params["b2"].astype(cd), e_of_row, axis=0)
+        return out
+
+    def _gmm_block(self, params, x, capacity: int, tm: int,
+                   interpret):
+        """One dispatch group through the Pallas grouped-matmul executor.
+
+        Routing is :func:`_top_k_select` — decisions and combine weights
+        bit-identical to every other executor; dropped (over-capacity)
+        pairs still own a buffer row but carry zero combine weight, so
+        they cost ``tm``-tile FLOPs yet never touch the output (exactly
+        the sorted-rows convention :meth:`_grouped_block` uses). Buffer
+        build and read-back ride the gather-only custom VJPs
+        (:func:`_rows_to_slots` / :func:`_slots_to_rows`)."""
+        from ..ops import grouped_matmul as G
+
+        n = x.shape[0]
+        f32 = jnp.float32
+        gates = jax.nn.softmax(
+            jnp.dot(x.astype(f32), params["wg"].astype(f32)), axis=-1)
+        eidx, slot, combine, (c1, gsum) = _top_k_select(
+            gates, capacity, self.k)
+        row, inv, tok_of_row, gmap = self._tile_layout(eidx, slot, n, tm)
+        m_pad = tok_of_row.shape[0]
+        use_kernel = (
+            G.tileable(m_pad, self.d_model, self.d_ff, tm)
+            and G.tileable(m_pad, self.d_ff, self.d_model, tm)
+        )
+        if interpret is None:
+            interpret = False
+            use_kernel = use_kernel and jax.default_backend() == "tpu"
+        keep_all = jnp.ones(eidx.shape, bool)  # every pair owns a row
+        xs = _rows_to_slots(x, tok_of_row, row, keep_all)
+        if self.activation == "swiglu" and not self.bias:
+            out = self._gmm_ffn_fused(G, params, xs, gmap, use_kernel,
+                                      bool(interpret))
+        else:
+            out = self._gmm_ffn(G, params, xs, gmap, tm, use_kernel,
+                                interpret)
+        rows = _slots_to_rows(out, row.reshape(-1), inv).reshape(
+            n, self.k, self.d_model).astype(f32)
+        y = jnp.sum(rows * combine[..., None].astype(f32), axis=1)
+        return y, c1, gsum
+
+    def apply_gmm(self, params: Dict[str, Any], x, ep: int = 1,
+                  tm: int = 128, interpret=None):
+        """Single-device MoE via the Pallas tile-aligned grouped matmul
+        (:mod:`..ops.grouped_matmul`): :meth:`apply_reference`'s contract
+        (same routing, same per-``ep``-group capacity quotas, same aux
+        loss) with each projection one ``gmm`` kernel call — ``k·N``
+        active rows plus ≤ ``E·tm`` tile padding on the MXU, a
+        scalar-prefetched tile→expert map steering weight DMA, f32
+        accumulators, and gather-only AD transposes end to end.
+        ``token_choice`` only. ``interpret``: None = kernel on TPU /
+        jnp reference elsewhere; True forces the kernel in interpret
+        mode (tests)."""
+        if self.routing != "token_choice":
+            raise ValueError(
+                "apply_gmm implements token_choice routing only; "
+                "use apply_reference for expert_choice")
+        n = x.shape[0]
+        if n % ep:
+            raise ValueError(f"{n} tokens not divisible by ep={ep}")
+        cap = self.capacity(n // ep)
+        ys, c1s, gsums = [], [], []
+        for blk in jnp.split(x, ep, axis=0):
+            y, c1, gsum = self._gmm_block(params, blk, cap, tm, interpret)
             ys.append(y)
             c1s.append(c1)
             gsums.append(gsum)

@@ -145,3 +145,71 @@ def test_learns_and_validates():
     with pytest.raises(ValueError, match="n_experts"):
         build_lm_train_step(bad, build_mesh_sp(data=2, seq=4),
                             optax.sgd(0.1), attn="ring")
+
+
+@pytest.mark.parametrize("dispatch", ["slots", "gmm", "ragged"])
+def test_single_device_dispatch_matches_onehot(dispatch):
+    """Every single-device executor must produce the onehot oracle's
+    trajectory (identical routing; float-tolerance sums)."""
+    import optax as _optax
+
+    kw = dict(vocab=13, d_model=16, n_heads=4, n_layers=2, d_ff=32,
+              max_len=32, n_experts=8, k=2, capacity_factor=1.25,
+              ep_groups=1)
+    tokens, positions, targets = _data()
+    mesh = build_mesh_sp(data=1, seq=1)
+    losses = {}
+    for d in ("onehot", dispatch):
+        model = MoETransformerLM(moe_dispatch=d, **kw)
+        step, opt_init = build_lm_train_step(model, mesh,
+                                             _optax.adam(1e-2),
+                                             attn="flash")
+        params = model.shard_params(mesh, model.init(seed=3))
+        state = opt_init(params)
+        td, pd, gd = shard_lm_batch(mesh, tokens, positions, targets)
+        ls = []
+        for _ in range(3):
+            params, state, loss = step(params, state, td, pd, gd)
+            ls.append(float(loss))
+        losses[d] = ls
+    np.testing.assert_allclose(losses[dispatch], losses["onehot"],
+                               rtol=5e-4, atol=5e-5)
+
+
+def test_bf16_param_storage_tracks_f32_trajectory():
+    """param_dtype='bfloat16' stores the expert stacks compactly; the
+    trajectory must track f32 storage closely (one bf16 rounding per
+    update) and dtypes must stay stable through the step."""
+    import optax as _optax
+
+    kw = dict(vocab=13, d_model=16, n_heads=4, n_layers=2, d_ff=32,
+              max_len=32, n_experts=8, k=2, capacity_factor=1.25,
+              ep_groups=1, activation="swiglu", ffn_bias=False)
+    tokens, positions, targets = _data()
+    mesh = build_mesh_sp(data=1, seq=1)
+    losses = {}
+    for pd in ("float32", "bfloat16"):
+        model = MoETransformerLM(param_dtype=pd, **kw)
+        step, opt_init = build_lm_train_step(model, mesh,
+                                             _optax.adam(1e-2),
+                                             attn="flash")
+        params = model.shard_params(mesh, model.init(seed=3))
+        if pd == "bfloat16":
+            assert params["w1"].dtype == jnp.bfloat16
+            assert params["wg"].dtype == jnp.float32  # router stays f32
+        state = opt_init(params)
+        td, pd_, gd = shard_lm_batch(mesh, tokens, positions, targets)
+        ls = []
+        for _ in range(4):
+            params, state, loss = step(params, state, td, pd_, gd)
+            ls.append(float(loss))
+        if pd == "bfloat16":
+            assert params["w1"].dtype == jnp.bfloat16  # dtype-stable
+        losses[pd] = ls
+    # At toy scale (d16) with lr 1e-2 the per-update bf16 rounding is a
+    # visible fraction of the update itself, so the contract here is
+    # "tracks and learns", not bit-parity (at the bench scale — d1024,
+    # lr 1e-3 — step-2 losses match f32 to 5 decimals; PERFORMANCE.md).
+    np.testing.assert_allclose(losses["bfloat16"], losses["float32"],
+                               rtol=1e-1)
+    assert losses["bfloat16"][-1] < losses["bfloat16"][0]
