@@ -64,6 +64,8 @@ from ..parallel.mesh import DATA_AXIS
 from .transformer import (
     SEQ_AXIS,
     TransformerLM,
+    _period_group,
+    _period_ungroup,
     _rope_angles,
     _rope_rotate,
     select_tokens,
@@ -101,17 +103,12 @@ def build_lm_generate(model: TransformerLM, mesh: Mesh,
                         f"sharded generate shards over {SEQ_AXIS!r}; param "
                         f"{name!r} has spec {spec}"
                     )
-    if model.attn_window is not None or getattr(model, "mixed_window",
-                                                False):
-        # The per-rank flash-decode partials + lse merge are window-ready
-        # (decode_attention_lse takes a window), but the owner-rank cache
-        # write logic below does not yet skip fully-expired ranks; guard
-        # until that lands rather than silently attending expired keys.
-        raise NotImplementedError(
-            "sequence-sharded generation does not support attn_window yet; "
-            "windowed models decode single-device (generate) where the "
-            "flash-decode kernel skips out-of-window cache blocks"
-        )
+    # Sliding windows (uniform or per-layer): the cache stays
+    # horizon-sharded (memory already divided by sp), each rank masks its
+    # local partial on GLOBAL window arithmetic — positions past a rank's
+    # slice end keep the offset identity (see _merged_decode_attention) —
+    # and wholly-expired ranks drop out of the logsumexp merge with −inf
+    # weight, exactly like not-yet-reached ranks.
     if DATA_AXIS not in mesh.shape or SEQ_AXIS not in mesh.shape:
         raise ValueError(
             f"mesh must carry ({DATA_AXIS!r}, {SEQ_AXIS!r}) axes, got "
@@ -139,18 +136,34 @@ def build_lm_generate(model: TransformerLM, mesh: Mesh,
     cd = model.compute_dtype
     programs: Dict[Any, Any] = {}
 
-    def _merged_decode_attention(qg, kc, vc, pos_local, Tl):
-        """Local flash-decode partial + logsumexp merge over "seq"."""
-        pos_cl = jnp.clip(pos_local, 0, Tl - 1)
-        o_r, lse_r = decode_attention_lse(qg, kc, vc, pos_cl)
-        # A rank whose slice starts past the decode position sees nothing:
-        # its (clamped-pos) partial is valid arithmetic over slot 0, and
-        # zero weight removes it from the merge.
-        lse_r = jnp.where(pos_local >= 0, lse_r, -jnp.inf)
+    def _merged_decode_attention(qg, kc, vc, pos_local, Tl, window):
+        """Local flash-decode partial + logsumexp merge over "seq".
+
+        ``window`` is THIS layer's sliding window (static; None = full).
+        The local kernel masks ``slot ≤ pos_local`` and ``slot >
+        pos_local − w``; since both slot and pos share the rank's global
+        offset ``r·Tl``, that IS the global window mask — including for
+        ranks whose slice the window has partially left, which pass their
+        true (past-the-end) ``pos_local`` so the lower bound stays
+        global. Ranks with nothing visible — not yet reached, or wholly
+        expired — clamp pos into valid kernel range and drop out of the
+        merge with −inf lse."""
+        if window is None:
+            pos_cl = jnp.clip(pos_local, 0, Tl - 1)
+            invalid = pos_local < 0
+        else:
+            w = int(window)
+            # upper clamp keeps ≥1 visible slot (valid arithmetic);
+            # genuinely expired ranks are overridden below anyway
+            pos_cl = jnp.clip(pos_local, 0, Tl + w - 2)
+            invalid = (pos_local < 0) | (pos_local - w + 1 >= Tl)
+        o_r, lse_r = decode_attention_lse(qg, kc, vc, pos_cl,
+                                          window=window)
+        lse_r = jnp.where(invalid, -jnp.inf, lse_r)
         m = jax.lax.pmax(lse_r, SEQ_AXIS)
-        w = jnp.exp(lse_r - m)                       # [B, Hkv, G]
-        num = jax.lax.psum(w[..., None] * o_r, SEQ_AXIS)
-        den = jax.lax.psum(w, SEQ_AXIS)
+        w_r = jnp.exp(lse_r - m)                     # [B, Hkv, G]
+        num = jax.lax.psum(w_r[..., None] * o_r, SEQ_AXIS)
+        den = jax.lax.psum(w_r, SEQ_AXIS)
         return num / den[..., None]                  # [B, Hkv, G, Dh]
 
     def _decode_step_sharded(params, token, p, kcache, vcache, Tl):
@@ -159,7 +172,8 @@ def build_lm_generate(model: TransformerLM, mesh: Mesh,
         ``token [B_local]`` at absolute position ``p`` (traced scalar);
         ``kcache/vcache [L, B_local, Hkv, Tl, Dh]``. Mirrors
         ``TransformerLM.decode_step`` with the attention and cache write
-        swapped for their sharded forms.
+        swapped for their sharded forms (including the per-layer window
+        period scan).
         """
         B = token.shape[0]
         r = jax.lax.axis_index(SEQ_AXIS)
@@ -173,8 +187,8 @@ def build_lm_generate(model: TransformerLM, mesh: Mesh,
             r_cos, r_sin = _rope_angles(pos_b, Dh, model.rope_theta)
             r_cos, r_sin = r_cos[:, None, :], r_sin[:, None, :]
 
-        def block(h, inputs):
-            lp, kc, vc = inputs                      # kc/vc [B, Hkv, Tl, Dh]
+        def one_layer(h, lp, kc, vc, window):
+            # kc/vc [B, Hkv, Tl, Dh]; ``window`` static for this layer
             x = model._norm_h(lp, "ln1", h).astype(cd)
             q = model._attn_proj(lp, "q", x).reshape(B, H, Dh)
             k_new = model._attn_proj(lp, "k", x).reshape(B, Hkv, 1, Dh)
@@ -193,7 +207,7 @@ def build_lm_generate(model: TransformerLM, mesh: Mesh,
             vc = jax.lax.dynamic_update_slice_in_dim(
                 vc, jnp.where(is_owner, v_new, cur_v), idx, axis=2)
             qg = q.reshape(B, Hkv, H // Hkv, Dh)
-            a = _merged_decode_attention(qg, kc, vc, pos_local, Tl)
+            a = _merged_decode_attention(qg, kc, vc, pos_local, Tl, window)
             a = a.astype(cd).reshape(B, H, Dh)
             h = h + model._attn_proj(lp, "o", a.reshape(B, model.d_model))
             x = model._norm_h(lp, "ln2", h).astype(cd)
@@ -204,10 +218,35 @@ def build_lm_generate(model: TransformerLM, mesh: Mesh,
             # tag entirely.
             out, _ = model._ffn(lp, x[:, None, :], "ring", SEQ_AXIS,
                                 ep_groups=1)
-            return h + out[:, 0].astype(cd), (kc, vc)
+            return h + out[:, 0].astype(cd), kc, vc
+
+        pp = model._window_period()
+
+        def block(h, inputs):
+            lp, kc, vc = inputs
+            if pp == 1:
+                h, kc, vc = one_layer(h, lp, kc, vc, model.attn_windows[0])
+                return h, (kc, vc)
+            kcs, vcs = [], []
+            for g in range(pp):
+                h, kc_g, vc_g = one_layer(
+                    h, {k: v[g] for k, v in lp.items()}, kc[g], vc[g],
+                    model.attn_windows[g])
+                kcs.append(kc_g)
+                vcs.append(vc_g)
+            return h, (jnp.stack(kcs), jnp.stack(vcs))
 
         lps = {k: params[k] for k in model._block_keys()}
-        h, (kc_new, vc_new) = jax.lax.scan(block, h, (lps, kcache, vcache))
+        kcache_s, vcache_s = kcache, vcache
+        if pp > 1:
+            lps = _period_group(lps, pp)
+            kcache_s = _period_group(kcache, pp)
+            vcache_s = _period_group(vcache, pp)
+        h, (kc_new, vc_new) = jax.lax.scan(
+            block, h, (lps, kcache_s, vcache_s))
+        if pp > 1:
+            kc_new = _period_ungroup(kc_new, model.n_layers)
+            vc_new = _period_ungroup(vc_new, model.n_layers)
         h = model._norm_h(params, "lnf", h)
         return model._logits(params, h), kc_new, vc_new
 

@@ -680,19 +680,15 @@ class TransformerLM:
                 k = _rope_rotate(k, *rope)
             return flash_attention(q, k, v, causal=True, window=w)
         if attn in ("ring", "ulysses"):
-            if w is not None:
-                # A window spanning at most one shard boundary could stop
-                # the ring after ceil(window/T_local) hops — not built yet.
-                raise NotImplementedError(
-                    "attn_window is not supported on the ring/ulysses "
-                    "sequence-parallel paths; train windowed models with "
-                    "attn='flash' (sp=1) or shard the batch axis instead"
-                )
+            # Sliding windows (uniform or per-layer) ride the sp paths:
+            # the ring masks on absolute positions and skips wholly-
+            # expired visits (O(T·window)); Ulysses' post-all-to-all
+            # sequence is global so the flash window applies unchanged.
             if attn == "ring":
                 return ring_attention_local(q, k, v, causal=True,
-                                            axis_name=seq_axis)
+                                            axis_name=seq_axis, window=w)
             return ulysses_attention_local(q, k, v, causal=True,
-                                           axis_name=seq_axis)
+                                           axis_name=seq_axis, window=w)
         raise ValueError(f"Unknown attn: {attn}")
 
     def apply(self, params: Dict[str, Any], tokens, positions,

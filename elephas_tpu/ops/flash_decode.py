@@ -139,7 +139,8 @@ def decode_attention_reference_lse(q, k, v, pos, window=None,
     return out, m + jnp.log(l)
 
 
-def _decode_kernel_lse(d_true: int, block_t: int, window, t_ring, pos_ref,
+def _decode_kernel_lse(d_true: int, block_t: int, window, t_ring,
+                       t_live, pos_ref,
                        q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s,
                        acc_s):
     """Online-softmax decode kernel with an lse output (lane-broadcast).
@@ -189,6 +190,11 @@ def _decode_kernel_lse(d_true: int, block_t: int, window, t_ring, pos_ref,
             keep = j <= pos_ref[b]
             if window is not None:
                 keep = jnp.logical_and(keep, j > pos_ref[b] - int(window))
+                # windowed callers may pass pos PAST the cache end (a
+                # sequence-sharded rank whose slice is partially expired
+                # keeps global window arithmetic that way) — alignment
+                # padding rows must then be masked explicitly
+                keep = jnp.logical_and(keep, j < t_live)
         s = jnp.where(keep, s, _NEG)
         m_prev = m_s[:, :1]
         m_cur = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -237,11 +243,14 @@ def flash_decode_lse(q, k, v, pos, interpret: bool = False, window=None,
         # blocks past row b's pos are never DMA'd
         kv_ix = lambda b, h, t, s: (b, h, jnp.minimum(t, s[b] // bt), 0)
     else:
-        # ...nor, under a sliding window, blocks wholly before it
+        # ...nor, under a sliding window, blocks wholly before it (the
+        # upper clip also bounds positions past the cache end — see the
+        # padding mask in the kernel)
         w = int(window)
         kv_ix = lambda b, h, t, s: (
             b, h,
-            jnp.clip(t, jnp.maximum((s[b] - w + 1) // bt, 0), s[b] // bt),
+            jnp.clip(t, jnp.maximum((s[b] - w + 1) // bt, 0),
+                     jnp.minimum(s[b] // bt, n_t - 1)),
             0)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
@@ -263,7 +272,7 @@ def flash_decode_lse(q, k, v, pos, interpret: bool = False, window=None,
     )
     out, lse = pl.pallas_call(
         functools.partial(_decode_kernel_lse, Dh, bt, window,
-                          T if ring else None),
+                          T if ring else None, T),
         out_shape=[
             jax.ShapeDtypeStruct((B, Hkv, Gp, Dh), jnp.float32),
             jax.ShapeDtypeStruct((B, Hkv, Gp, _LANE), jnp.float32),

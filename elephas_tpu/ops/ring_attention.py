@@ -73,11 +73,16 @@ def attention_reference(q, k, v, causal: bool = False, window=None):
     return out.astype(q.dtype)
 
 
-def _ring_attention_local(q, k, v, causal: bool, axis_name: str):
+def _ring_attention_local(q, k, v, causal: bool, axis_name: str,
+                          window=None):
     """Per-shard body: runs INSIDE shard_map. ``q``: local sequence block
     ``[B, Tb, H, D]``; ``k``/``v`` may carry fewer (divisor) KV heads —
     the ring's ppermute hops then move only the small blocks, and heads
-    broadcast at the local score compute."""
+    broadcast at the local score compute. ``window`` (causal only):
+    sliding-window attention masked on ABSOLUTE positions, so windows
+    spanning any number of shard boundaries are exact."""
+    if window is not None and not causal:
+        raise ValueError("window requires causal attention")
     p = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     b, tq, h, d = q.shape
@@ -99,6 +104,8 @@ def _ring_attention_local(q, k, v, causal: bool, axis_name: str):
         if causal:
             kpos = src * tk + jnp.arange(tk)
             mask = kpos[None, :] <= qpos[:, None]  # [Tq, Tk]
+            if window is not None:
+                mask &= kpos[None, :] > qpos[:, None] - int(window)
             scores = jnp.where(mask[None, None], scores, -jnp.inf)
         vb_full = jnp.transpose(repeat_kv_heads(vb, h), (0, 2, 1, 3))
         return fold_softmax_block(scores, vb_full, m, l, acc)
@@ -126,7 +133,7 @@ def _ring_attention_local(q, k, v, causal: bool, axis_name: str):
 
 
 def _ring_flash_local(q, k, v, causal: bool, axis_name: str,
-                      interpret: bool = False):
+                      interpret: bool = False, window=None):
     """TPU per-shard ring body: per-visit Pallas flash + lse merge.
 
     Each visiting KV block is attended with the fused
@@ -145,12 +152,23 @@ def _ring_flash_local(q, k, v, causal: bool, axis_name: str,
     into its Δ term) and the jnp merge — no hand-written ring backward.
     Autodiff stores per-visit residuals (O(P · local block) — the memory
     the forward saves is the score tensor, not the residual stream).
+
+    ``window`` (causal only) extends the per-visit classification:
+    wholly-expired blocks (every key below every query's window) SKIP —
+    the compute is O(T·window) as the window shrinks — the diagonal runs
+    the kernel's own windowed mask, still-fully-visible blocks run plain
+    flash, and the ≤⌈window/Tk⌉ boundary blocks whose visibility is
+    PARTIAL fall back to one materialized banded-score fold (the kernel's
+    static window mask cannot express a traced cross-block offset).
     """
     p = jax.lax.axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     from .pallas_flash import flash_attention_with_lse
 
+    if window is not None and not causal:
+        raise ValueError("window requires causal attention")
     b, tq, h, _ = q.shape
+    tk = k.shape[1]
     perm = [(i, (i + 1) % p) for i in range(p)]
 
     from .pallas_flash import _BK, _BQ
@@ -161,7 +179,7 @@ def _ring_flash_local(q, k, v, causal: bool, axis_name: str,
 
     def diag(q, kb, vb):
         return flash_attention_with_lse(q, kb, vb, True, _BQ, _BK,
-                                        interpret)
+                                        interpret, window=window)
 
     def skip(q, kb, vb):
         return (jnp.zeros(q.shape, q.dtype),
@@ -169,7 +187,55 @@ def _ring_flash_local(q, k, v, causal: bool, axis_name: str,
 
     def visit(acc, lse_acc, kb, vb, j):
         src = (rank - j) % p
-        if causal:
+        if causal and window is not None:
+            w = int(window)
+            kpos0 = src * tk  # visiting block's absolute key origin
+            # 0 skip: causally invisible OR wholly below every query's
+            #   window (max key < min query − (w−1));
+            # 1 diag: the resident block — kernel-masked causal+window;
+            # 2 full: earlier block, newest-possible-expiry query still
+            #   sees its oldest key (min key > max query − w);
+            # 3 partial: earlier block crossed by the window boundary —
+            #   banded jnp fold on absolute positions.
+            earlier = src < rank
+            expired = kpos0 + tk - 1 < rank * tq - (w - 1)
+            full_vis = kpos0 > (rank * tq + tq - 1) - w
+
+            def partial_blk(q, kb, vb):
+                scale = q.shape[-1] ** -0.5
+                scores = jnp.einsum(
+                    "bqhd,bkhd->bhqk", q, repeat_kv_heads(kb, h),
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST
+                ) * scale
+                qpos = rank * tq + jnp.arange(tq)
+                kpos = kpos0 + jnp.arange(tk)
+                mask = (kpos[None, :] <= qpos[:, None]) & (
+                    kpos[None, :] > qpos[:, None] - w)
+                scores = jnp.where(mask[None, None], scores, -jnp.inf)
+                m = jnp.max(scores, axis=-1)
+                safe = jnp.where(jnp.isneginf(m), 0.0, m)
+                e = jnp.exp(scores - safe[..., None])
+                e = jnp.where(mask[None, None], e, 0.0)
+                l = jnp.sum(e, axis=-1)
+                o = jnp.einsum(
+                    "bhqk,bkhd->bqhd", e, repeat_kv_heads(vb, h),
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST
+                ) / jnp.transpose(jnp.maximum(l, 1e-30), (0, 2, 1))[
+                    ..., None]
+                lse = jnp.where(jnp.isneginf(m), -jnp.inf,
+                                safe + jnp.log(jnp.maximum(l, 1e-30)))
+                return (o.astype(q.dtype),
+                        jnp.transpose(lse, (0, 2, 1)))  # [B, Tq, H]
+
+            idx = jnp.where(
+                src == rank, 1,
+                jnp.where(~earlier | expired, 0,
+                          jnp.where(full_vis, 2, 3))).astype(jnp.int32)
+            o_j, lse_j = jax.lax.switch(
+                idx, [skip, diag, full, partial_blk], q, kb, vb)
+        elif causal:
             # 0: origin > rank (invisible), 1: diagonal, 2: fully visible
             idx = (src < rank).astype(jnp.int32) * 2 + (
                 src == rank
@@ -204,41 +270,45 @@ def _ring_flash_local(q, k, v, causal: bool, axis_name: str,
     return acc.astype(q.dtype)
 
 
-def ring_attention_local(q, k, v, causal: bool, axis_name: str):
+def ring_attention_local(q, k, v, causal: bool, axis_name: str,
+                         window=None):
     """Per-shard ring attention body for composing INSIDE a larger
     shard_map program (e.g. the sequence-parallel transformer in
     ``models/transformer.py``): the fused Pallas path on TPU, the jnp
     online-softmax fold elsewhere. Both branches are pinned against the
     dense ``attention_reference`` oracle (the Pallas one in interpret mode,
-    ``tests/ops/test_pallas_flash.py``)."""
+    ``tests/ops/test_pallas_flash.py``). ``window``: sliding-window
+    attention on absolute positions (causal only)."""
     from .pallas_ops import is_tpu_backend
 
     if is_tpu_backend():
-        return _ring_flash_local(q, k, v, causal, axis_name)
-    return _ring_attention_local(q, k, v, causal, axis_name)
+        return _ring_flash_local(q, k, v, causal, axis_name, window=window)
+    return _ring_attention_local(q, k, v, causal, axis_name, window=window)
 
 _COMPILED = {}
 
 
 def sharded_seq_attention(tag: str, local_fn, mesh, axis_name: str,
-                          causal: bool, q, k, v):
+                          causal: bool, q, k, v, window=None):
     """Shared harness for the sequence-parallel attention schedules (ring,
     Ulysses): shard ``q``/``k``/``v`` along the sequence dim over
     ``axis_name``, run ``local_fn`` (a per-shard body taking
-    ``causal``/``axis_name`` kwargs) inside ``shard_map``, and cache the
-    compiled executable per ``(tag, mesh, axis, causal)`` — shapes/dtypes
-    hit jit's own cache; the dict is FIFO-bounded."""
+    ``causal``/``axis_name``/``window`` kwargs) inside ``shard_map``, and
+    cache the compiled executable per ``(tag, mesh, axis, causal,
+    window)`` — shapes/dtypes hit jit's own cache; the dict is
+    FIFO-bounded."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     spec = P(None, axis_name)  # shard the sequence dim
-    key = (tag, mesh, axis_name, causal)
+    key = (tag, mesh, axis_name, causal, window)
     fn = _COMPILED.get(key)
     if fn is None:
         if len(_COMPILED) >= 16:  # bound the executable cache
             _COMPILED.pop(next(iter(_COMPILED)))
         fn = jax.jit(
             jax.shard_map(
-                partial(local_fn, causal=causal, axis_name=axis_name),
+                partial(local_fn, causal=causal, axis_name=axis_name,
+                        window=window),
                 mesh=mesh,
                 in_specs=(spec, spec, spec),
                 out_specs=spec,
@@ -252,14 +322,15 @@ def sharded_seq_attention(tag: str, local_fn, mesh, axis_name: str,
 
 
 def ring_attention(q, k, v, mesh=None, causal: bool = False,
-                   axis_name: str = DATA_AXIS):
+                   axis_name: str = DATA_AXIS, window=None):
     """Exact attention over sequences sharded across a mesh axis.
 
     ``q``/``k``/``v``: ``[B, T, H, D]`` with ``T`` divisible by the ring size
     (the ``axis_name`` extent of ``mesh``). Inputs may be host arrays (they
     are sharded along ``T``) or already sharded. Equals
-    :func:`attention_reference` on the gathered sequence; bf16 inputs
-    accumulate in float32.
+    :func:`attention_reference` on the gathered sequence (including
+    ``window``, masked on absolute positions); bf16 inputs accumulate in
+    float32.
     """
     if mesh is None:
         from ..parallel.mesh import build_mesh
@@ -270,5 +341,6 @@ def ring_attention(q, k, v, mesh=None, causal: bool = False,
     if t % p:
         raise ValueError(f"sequence length {t} not divisible by ring size {p}")
     return sharded_seq_attention(
-        "ring", ring_attention_local, mesh, axis_name, causal, q, k, v
+        "ring", ring_attention_local, mesh, axis_name, causal, q, k, v,
+        window=window,
     )

@@ -32,12 +32,20 @@ from .flash_attention import flash_attention, repeat_kv_heads
 from .ring_attention import sharded_seq_attention
 
 
-def _ulysses_local(q, k, v, causal: bool, axis_name: str):
+def _ulysses_local(q, k, v, causal: bool, axis_name: str, window=None):
     """Per-shard body INSIDE shard_map. ``q``: local sequence block
     ``[B, T/P, H, D]`` → out ``[B, T/P, H, D]``. ``k``/``v`` may carry
     fewer (divisor) KV heads: when the KV head count still divides the
     group size, the all_to_alls move only the small blocks and flash
-    broadcasts locally; otherwise heads broadcast before the re-shard."""
+    broadcasts locally; otherwise heads broadcast before the re-shard.
+
+    ``window`` (sliding-window attention, causal only) passes straight
+    through to the local flash call: after the head↔sequence all-to-all
+    each device holds the FULL sequence, so within-sequence positions are
+    global and the kernel's windowed mask (and its out-of-window tile
+    skipping) applies unchanged."""
+    if window is not None and not causal:
+        raise ValueError("window requires causal attention")
     p = jax.lax.axis_size(axis_name)
     h = q.shape[2]
     if k.shape[2] % p:
@@ -52,7 +60,7 @@ def _ulysses_local(q, k, v, causal: bool, axis_name: str):
     # full sequence per head group here — blockwise flash keeps the local
     # attention O(T·block) instead of materializing [T, T] (and finishes
     # any remaining KV-head broadcast)
-    out = flash_attention(qh, kh, vh, causal=causal)
+    out = flash_attention(qh, kh, vh, causal=causal, window=window)
     # seq-full/head-sharded → seq-sharded/head-full
     return jax.lax.all_to_all(
         out, axis_name=axis_name, split_axis=1, concat_axis=2, tiled=True
@@ -65,14 +73,15 @@ ulysses_attention_local = _ulysses_local
 
 
 def ulysses_attention(q, k, v, mesh=None, causal: bool = False,
-                      axis_name: str = DATA_AXIS):
+                      axis_name: str = DATA_AXIS, window=None):
     """Exact attention over sequences sharded across a mesh axis, via
     head↔sequence all-to-alls.
 
     ``q``/``k``/``v``: ``[B, T, H, D]`` with ``T`` and ``H`` divisible by the
     group size (the ``axis_name`` extent of ``mesh``). Same contract (and
     shared compile-cache harness) as
-    :func:`~elephas_tpu.ops.ring_attention.ring_attention`.
+    :func:`~elephas_tpu.ops.ring_attention.ring_attention`, including
+    sliding ``window`` (causal only).
     """
     if mesh is None:
         from ..parallel.mesh import build_mesh
@@ -85,5 +94,6 @@ def ulysses_attention(q, k, v, mesh=None, causal: bool = False,
     if h % p:
         raise ValueError(f"head count {h} not divisible by group size {p}")
     return sharded_seq_attention(
-        "ulysses", _ulysses_local, mesh, axis_name, causal, q, k, v
+        "ulysses", _ulysses_local, mesh, axis_name, causal, q, k, v,
+        window=window,
     )

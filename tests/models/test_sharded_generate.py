@@ -155,3 +155,54 @@ def test_moe_bad_expert_count_rejected():
     mesh = build_mesh_sp(data=2, seq=4)
     with pytest.raises(ValueError, match="n_experts"):
         build_lm_generate(model, mesh)
+
+
+@pytest.mark.parametrize("window", [6, 20])
+def test_windowed_greedy_matches_single_device(window):
+    """Round 5: sliding-window models generate sharded. Window 6 < the
+    8-slot cache slice (ranks expire mid-rollout); window 20 spans
+    several slices (partial-expiry arithmetic past a rank's slice end)."""
+    model = _model(attn_window=window)
+    params = _jp(model.init(seed=4))
+    mesh = build_mesh_sp(data=2, seq=4)
+    prompt = _prompt(2, 5)
+    n_new = 19
+
+    want = np.asarray(model.generate(params, prompt, n_new))
+    gen = build_lm_generate(model, mesh)
+    got = np.asarray(gen(model.shard_params(mesh, params), prompt, n_new))
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("windows", [(None, 6), (4, 10)])
+def test_mixed_window_greedy_matches_single_device(windows):
+    """Per-layer windows (Gemma-2-style alternation) through the sharded
+    decode's period scan — each layer masks its own window globally."""
+    model = _model(attn_window=list(windows))
+    params = _jp(model.init(seed=5))
+    mesh = build_mesh_sp(data=2, seq=4)
+    prompt = _prompt(2, 5)
+    n_new = 19
+
+    want = np.asarray(model.generate(params, prompt, n_new))
+    gen = build_lm_generate(model, mesh)
+    got = np.asarray(gen(model.shard_params(mesh, params), prompt, n_new))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_mixed_window_moe_greedy_matches_single_device():
+    """The Mixtral/Qwen2 composition: MoE experts sharded over "seq" AND
+    per-layer windows in the same sharded rollout."""
+    moe = MoETransformerLM(
+        vocab=64, d_model=32, n_heads=4, n_layers=2, d_ff=64, max_len=64,
+        n_experts=8, k=2, capacity_factor=8.0, pos_encoding="rotary",
+        norm="rmsnorm", activation="swiglu", ffn_bias=False,
+        attn_window=[None, 6])
+    params = _jp(moe.init(seed=6))
+    mesh = build_mesh_sp(data=1, seq=4)
+    prompt = _prompt(2, 5)
+
+    want = np.asarray(moe.generate(params, prompt, 13))
+    gen = build_lm_generate(moe, mesh)
+    got = np.asarray(gen(moe.shard_params(mesh, params), prompt, 13))
+    np.testing.assert_array_equal(got, want)

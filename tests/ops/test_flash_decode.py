@@ -168,3 +168,30 @@ def test_per_row_positions():
                                             v[b:b + 1], int(pos[b]))
         np.testing.assert_allclose(got[b:b + 1], want_b, atol=1e-5,
                                    rtol=1e-5, err_msg=f"row {b}")
+
+
+def test_lse_windowed_and_past_end_positions():
+    """Round 5: the windowed kernel must accept positions PAST the cache
+    end (a sequence-sharded rank whose slice the window partially left
+    keeps global arithmetic that way) — alignment-padding rows masked,
+    kv block index clipped, exact vs the reference at every pos in and
+    beyond the cache."""
+    from elephas_tpu.ops.flash_decode import (
+        decode_attention_reference_lse,
+        flash_decode_lse,
+    )
+
+    rng = np.random.default_rng(6)
+    hkv, g, dh, t, w = 2, 2, 16, 40, 12
+    q = rand(rng, 2, hkv, g, dh)
+    k = rand(rng, 2, hkv, t, dh)
+    v = rand(rng, 2, hkv, t, dh)
+    for pos in (0, 5, t - 1, t, t + w // 2, t + w - 2):
+        got_o, got_lse = flash_decode_lse(q, k, v, pos, interpret=True,
+                                          window=w)
+        want_o, want_lse = decode_attention_reference_lse(q, k, v, pos,
+                                                          window=w)
+        np.testing.assert_allclose(got_o, want_o, atol=1e-5, rtol=1e-5,
+                                   err_msg=f"out pos={pos}")
+        np.testing.assert_allclose(got_lse, want_lse, atol=1e-5,
+                                   rtol=1e-5, err_msg=f"lse pos={pos}")

@@ -125,8 +125,8 @@ def test_ulysses_with_pallas_kernel_matches_oracle(monkeypatch):
     ul = sys.modules["elephas_tpu.ops.ulysses"]
     monkeypatch.setattr(
         ul, "flash_attention",
-        lambda q, k, v, causal=False: flash_attention_tpu(
-            q, k, v, causal, 128, 128, True),
+        lambda q, k, v, causal=False, window=None: flash_attention_tpu(
+            q, k, v, causal, 128, 128, True, window=window),
     )
 
     rng = np.random.default_rng(5)
@@ -236,3 +236,50 @@ def test_rope_fused_matches_prerotated_oracle(hkv, dh, t):
     for name, a, b in zip(("dq", "dk", "dv"), got, want):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    atol=2e-5, rtol=2e-5, err_msg=name)
+
+
+@pytest.mark.parametrize("window", [24, 64, 130])
+def test_windowed_ring_with_pallas_kernel_matches_oracle(window):
+    """Round 5: the TPU ring body's 4-way windowed switch (skip/diag/full/
+    banded-partial) in interpret mode vs the dense windowed oracle,
+    gradients included — windows below / at / past the 64-token shard
+    exercise every branch, including the banded partial fold's autodiff."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from elephas_tpu.ops import attention_reference
+    from elephas_tpu.ops.ring_attention import _ring_flash_local
+    from elephas_tpu.parallel import build_mesh
+
+    rng = np.random.default_rng(8)
+    B, T, H, Dh = 1, 256, 2, 32
+    q = _rand(rng, B, T, H, Dh)
+    k = _rand(rng, B, T, H, Dh)
+    v = _rand(rng, B, T, H, Dh)
+    g = _rand(rng, B, T, H, Dh)
+    mesh = build_mesh(4)
+
+    fwd = jax.jit(jax.shard_map(
+        lambda q, k, v: _ring_flash_local(q, k, v, True, "data",
+                                          interpret=True, window=window),
+        mesh=mesh, in_specs=P(None, "data"), out_specs=P(None, "data"),
+        check_vma=False,
+    ))
+    spec = NamedSharding(mesh, P(None, "data"))
+    qd, kd, vd = (jax.device_put(a, spec) for a in (q, k, v))
+    want = attention_reference(q, k, v, causal=True, window=window)
+    np.testing.assert_allclose(np.asarray(fwd(qd, kd, vd)),
+                               np.asarray(want), atol=2e-5, rtol=2e-5)
+
+    def loss(q, k, v):
+        return jnp.sum(fwd(q, k, v) * g)
+
+    def oracle_loss(q, k, v):
+        return jnp.sum(
+            attention_reference(q, k, v, causal=True, window=window) * g)
+
+    got = jax.grad(loss, argnums=(0, 1, 2))(qd, kd, vd)
+    ref = jax.grad(oracle_loss, argnums=(0, 1, 2))(q, k, v)
+    for name, a, b in zip(("dq", "dk", "dv"), got, ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=3e-5, rtol=3e-5, err_msg=name)

@@ -94,3 +94,16 @@ def test_gqa_kv_heads(hkv):
         causal=True,
     ))
     np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("window", [3, 13, 24])
+def test_windowed_matches_oracle(window):
+    """The post-all-to-all sequence is global, so the flash window mask
+    must reproduce the dense windowed oracle exactly."""
+    q, k, v = _qkv()
+    mesh = build_mesh(8)
+    ref = np.asarray(attention_reference(q, k, v, causal=True,
+                                         window=window))
+    out = np.asarray(ulysses_attention(q, k, v, mesh=mesh, causal=True,
+                                       window=window))
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
