@@ -277,14 +277,32 @@ def _prefill_jit(model, params, prompt, length: int, chunk: int):
                          model.init_cache(B, length, chunk=chunk))
 
 
-@partial(jax.jit, static_argnames=("target", "draft", "spec_k", "total"))
+@partial(jax.jit, static_argnames=("target", "draft", "spec_k", "total",
+                                   "sampled"))
 def _spec_rollout_device(target, draft, params, draft_params, t_cache,
                          d_cache, carry0, buf0, pos0, spec_k: int,
-                         total: int):
-    """The compiled greedy speculative round loop (see
+                         total: int, sampled: bool = False,
+                         temperature=1.0, key0=None):
+    """The compiled speculative round loop (see
     ``TransformerLM._generate_speculative_device``). ``target``/``draft``
     are static (hashable by identity — the jit cache keys on the model
     instances, so repeated rollouts at one geometry reuse the executable).
+
+    ``sampled=False``: greedy — accept while the target argmax agrees
+    (a cumprod over the match mask), correction = the target argmax at
+    the first disagreement; output pinned equal to the host driver and
+    the target's own greedy rollout. ``sampled=True`` (round 5; only the
+    BOOL is static — ``temperature`` is a traced scalar, so serving many
+    temperatures reuses one executable): the
+    distribution-preserving rejection rule ON DEVICE in f32 — the draft
+    SAMPLES its proposals (``jax.random.categorical`` per step), each is
+    accepted w.p. ``min(1, p_t(d)/p_d(d))``, the first rejection
+    resamples from the residual ``(p_t − p_d)+`` (normalized), and a
+    fully-accepted round draws its bonus token from ``p_t`` — expressed
+    uniformly by padding ``p_d`` with a zero row at index ``spec_k`` so
+    the residual at the bonus slot IS ``p_t``. The host driver
+    (``_spec_accept_row``, f64) stays the distributional oracle; the two
+    match in DISTRIBUTION, not bitwise (independent RNG streams).
 
     Returns ``(buf, (rounds, proposed, accepted))``; ``buf[:, :total]``
     is the output. Per-row invariants mirror the batched host loop: rows
@@ -295,32 +313,70 @@ def _spec_rollout_device(target, draft, params, draft_params, t_cache,
     B = carry0.shape[0]
     rows = jnp.arange(B)
     zero = jnp.zeros((), jnp.int32)
+    inv_t = 1.0 / jnp.asarray(temperature, jnp.float32)
+    if key0 is None:
+        key0 = jax.random.PRNGKey(0)
 
     def cond(state):
         pos = state[0]
         return jnp.any(pos + 1 < total)
 
     def body(state):
-        pos, carry, buf, t_cache, d_cache, (rounds, proposed, acc) = state
+        pos, carry, buf, t_cache, d_cache, key, stats = state
+        rounds, proposed, acc = stats
         active = (pos + 1) < total
+        key, kd, ka, kc = jax.random.split(key, 4)
 
-        def dstep(c, _):
+        def dstep(c, kdi):
             tok, p, dc = c
             dl, dc = draft.decode_step(draft_params, tok, p, dc)
-            nt = jnp.argmax(dl, axis=-1).astype(jnp.int32)
-            return (nt, p + 1, dc), nt
+            if sampled:
+                scaled = dl.astype(jnp.float32) * inv_t
+                nt = jax.random.categorical(kdi, scaled,
+                                            axis=-1).astype(jnp.int32)
+                pd = jax.nn.softmax(scaled, axis=-1)  # [B, V] f32
+            else:
+                nt = jnp.argmax(dl, axis=-1).astype(jnp.int32)
+                pd = jnp.zeros((B, 0), jnp.float32)  # unused
+            return (nt, p + 1, dc), (nt, pd)
 
-        (_, pend, d_cache), d_toks = jax.lax.scan(
-            dstep, (carry, pos, d_cache), None, length=spec_k)
+        (_, pend, d_cache), (d_toks, d_pd) = jax.lax.scan(
+            dstep, (carry, pos, d_cache), jax.random.split(kd, spec_k))
         d_toks = d_toks.T  # [B, spec_k]
         chunk = jnp.concatenate([carry[:, None], d_toks], axis=1)
         vl, t_cache = target.decode_chunk(params, chunk, pos, t_cache)
-        t_arg = jnp.argmax(vl, axis=-1).astype(jnp.int32)  # [B, spec_k+1]
-        # greedy acceptance: longest agreeing prefix, then the target's
-        # correction/bonus token — `_spec_accept_row`'s t<=0 branch
-        match = (t_arg[:, :spec_k] == d_toks).astype(jnp.int32)
-        n = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # [B]
-        corr = jnp.take_along_axis(t_arg, n[:, None], axis=1)[:, 0]
+        if sampled:
+            pt = jax.nn.softmax(vl.astype(jnp.float32) * inv_t,
+                                axis=-1)                 # [B, k+1, V]
+            pd = jnp.concatenate(
+                [jnp.transpose(d_pd, (1, 0, 2)),
+                 jnp.zeros((B, 1, pt.shape[-1]), jnp.float32)], axis=1)
+            pt_d = jnp.take_along_axis(
+                pt[:, :spec_k], d_toks[..., None], axis=-1)[..., 0]
+            pd_d = jnp.take_along_axis(
+                pd[:, :spec_k], d_toks[..., None], axis=-1)[..., 0]
+            ratio = pt_d / jnp.maximum(pd_d, 1e-20)      # [B, spec_k]
+            u = jax.random.uniform(ka, (B, spec_k), jnp.float32)
+            accept = (u < jnp.minimum(ratio, 1.0)).astype(jnp.int32)
+            n = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)  # [B]
+            # residual at the stop slot (p_t itself at the bonus slot —
+            # pd's zero padding row makes the formula uniform)
+            ptn = jnp.take_along_axis(pt, n[:, None, None],
+                                      axis=1)[:, 0]      # [B, V]
+            pdn = jnp.take_along_axis(pd, n[:, None, None], axis=1)[:, 0]
+            resid = jnp.maximum(ptn - pdn, 0.0)
+            z = jnp.sum(resid, axis=-1, keepdims=True)
+            resid = jnp.where(z > 0, resid / jnp.maximum(z, 1e-30), ptn)
+            corr = jax.random.categorical(
+                kc, jnp.log(jnp.maximum(resid, 1e-30)),
+                axis=-1).astype(jnp.int32)
+        else:
+            t_arg = jnp.argmax(vl, axis=-1).astype(jnp.int32)
+            # greedy acceptance: longest agreeing prefix, then the
+            # target's correction/bonus — `_spec_accept_row`'s t<=0 branch
+            match = (t_arg[:, :spec_k] == d_toks).astype(jnp.int32)
+            n = jnp.sum(jnp.cumprod(match, axis=1), axis=1)  # [B]
+            corr = jnp.take_along_axis(t_arg, n[:, None], axis=1)[:, 0]
         for i in range(spec_k + 1):  # masked variable-length emission
             val = d_toks[:, i] if i < spec_k else corr
             val = jnp.where(jnp.int32(i) < n, val, corr)
@@ -335,10 +391,11 @@ def _spec_rollout_device(target, draft, params, draft_params, t_cache,
         nact = jnp.sum(active.astype(jnp.int32))
         stats = (rounds + 1, proposed + spec_k * nact,
                  acc + jnp.sum(jnp.where(active, n, zero)))
-        return pos, carry, buf, t_cache, d_cache, stats
+        return pos, carry, buf, t_cache, d_cache, key, stats
 
-    state = (pos0, carry0, buf0, t_cache, d_cache, (zero, zero, zero))
-    pos, carry, buf, _, _, stats = jax.lax.while_loop(cond, body, state)
+    state = (pos0, carry0, buf0, t_cache, d_cache, key0,
+             (zero, zero, zero))
+    pos, carry, buf, _, _, _, stats = jax.lax.while_loop(cond, body, state)
     return buf, stats
 
 
@@ -1212,24 +1269,28 @@ class TransformerLM:
 
     def _generate_speculative_device(self, params, prompt, n_new: int,
                                      draft, draft_params, spec_k: int,
-                                     with_stats: bool):
-        """Greedy speculative decoding as ONE compiled program.
+                                     with_stats: bool,
+                                     temperature: float = 0.0,
+                                     seed: int = 0):
+        """Speculative decoding as ONE compiled program.
 
         The host loops (:meth:`generate_speculative` batch-1 and
         `_generate_speculative_batched`) pay ``spec_k + 2`` relay
         dispatches per round — on a relay-attached chip that inverts the
         algorithmic win (docs/PERFORMANCE.md config 7). Here the whole
         draft→verify→accept round loop is a ``lax.while_loop`` inside one
-        jit: the greedy acceptance rule (accept while the target's argmax
-        agrees; `_spec_accept_row`'s ``temperature<=0`` branch) runs
-        on-device as a cumprod over the match mask, variable-length
+        jit: greedy acceptance (accept while the target's argmax agrees;
+        `_spec_accept_row`'s ``temperature<=0`` branch) as a cumprod over
+        the match mask, or — round 5 — the sampled rejection rule in f32
+        with on-device RNG (see ``_spec_rollout_device``); variable-length
         emissions land in a per-row token buffer via masked writes, and
         finished rows freeze exactly like the batched host loop. ONE
         dispatch for the entire rollout (after the two prefills) —
-        dispatches per emitted token < 1 by construction. Output is pinned
-        equal to the host loops and to the target's own greedy rollout;
-        the host path remains the oracle (and the sampled-mode
-        implementation, whose f64 rejection math stays host-side).
+        dispatches per emitted token < 1 by construction. Greedy output is
+        pinned equal to the host loops and the target's own greedy
+        rollout; sampled output matches the host driver's f64 rule in
+        DISTRIBUTION (``tests/models/test_speculative.py`` pins the
+        per-position frequencies against the target's own sampling).
         """
         B, T0 = prompt.shape
         total = T0 + int(n_new)
@@ -1238,13 +1299,23 @@ class TransformerLM:
                                          spec_k + 1)
         _, d_cache = _prefill_jit(draft, draft_params, prompt, horizon,
                                   spec_k + 1)
-        carry0 = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)
+        key = jax.random.PRNGKey(seed)
+        if temperature > 0.0:
+            key, k0 = jax.random.split(key)
+            carry0 = jax.random.categorical(
+                k0, t_logits[:, -1].astype(jnp.float32) / temperature,
+                axis=-1).astype(jnp.int32)
+        else:
+            carry0 = jnp.argmax(t_logits[:, -1], axis=-1).astype(jnp.int32)
         buf0 = jnp.zeros((B, total + spec_k + 1), jnp.int32)
         buf0 = buf0.at[:, :T0].set(prompt).at[:, T0].set(carry0)
         pos0 = jnp.full((B,), T0, jnp.int32)
         buf, (rounds, proposed, accepted) = _spec_rollout_device(
             self, draft, params, draft_params, t_cache, d_cache,
-            carry0, buf0, pos0, spec_k=spec_k, total=total)
+            carry0, buf0, pos0, spec_k=spec_k, total=total,
+            sampled=temperature > 0.0,
+            temperature=float(temperature) if temperature > 0.0 else 1.0,
+            key0=key)
         tokens = buf[:, :total]
         if with_stats:
             proposed = int(proposed)
@@ -1287,10 +1358,12 @@ class TransformerLM:
         vocabulary; proposals use plain temperature sampling
         (no top-k/top-p). Latency-oriented: fewer sequential target steps
         per emitted token at the cost of draft work — the win grows with
-        the target/draft size ratio. Greedy requests execute as one
-        compiled on-device round loop (``host_loop=True`` forces the
-        host-driver oracle path instead); sampled requests always use the
-        host driver (f64 rejection math). ``with_stats=True`` additionally
+        the target/draft size ratio. Both greedy AND sampled requests
+        execute as one compiled on-device round loop (``host_loop=True``
+        forces the host-driver path instead — for greedy that path is the
+        bit-exact oracle, for sampled it carries the f64 rejection math
+        the device's f32 rule is distribution-checked against).
+        ``with_stats=True`` additionally
         returns ``{rounds, proposed, accepted, acceptance_rate,
         tokens_emitted}`` — ``rounds`` is the number of sequential target
         passes, vs ``n_new`` for plain cached decode (the measured
@@ -1331,15 +1404,18 @@ class TransformerLM:
             )
         if n_new < 1:
             return prompt
-        if temperature <= 0.0 and not host_loop:
-            # Greedy rounds run as ONE compiled while_loop program —
-            # dispatches per emitted token < 1 (the wall-clock win on a
-            # dispatch-latency-dominated rig). The host loops below stay
-            # as the oracle (tests pin device == host == target-greedy)
-            # and carry the f64 sampled-mode rejection math.
+        if not host_loop:
+            # Rounds run as ONE compiled while_loop program — dispatches
+            # per emitted token < 1 (the wall-clock win on a
+            # dispatch-latency-dominated rig). Greedy: pinned equal to
+            # the host loops and the target's own greedy rollout.
+            # Sampled (round 5): the rejection rule on-device in f32 —
+            # the host driver below stays the f64 distributional oracle
+            # (host_loop=True forces it).
             return self._generate_speculative_device(
                 params, prompt, int(n_new), draft, draft_params,
-                int(spec_k), with_stats)
+                int(spec_k), with_stats, temperature=float(temperature),
+                seed=int(seed))
         if B != 1:
             return self._generate_speculative_batched(
                 params, prompt, int(n_new), draft, draft_params,

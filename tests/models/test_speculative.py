@@ -294,3 +294,76 @@ def test_batched_sampled_contract():
     np.testing.assert_array_equal(a[:, :4], prompt)
     assert 0 <= stats["accepted"] <= stats["proposed"]
     assert stats["tokens_emitted"] == 3 * 9
+
+
+def test_sampled_device_rollout_contract():
+    """Round 5: sampled rounds run on-device (f32 rejection rule). Same
+    structural contract as greedy: prompt preserved, vocab range, rows
+    freeze at total, deterministic per seed, stats coherent."""
+    target = _model()
+    t_params = _params(target, 3)
+    draft = _model(d_model=8, n_heads=2, n_layers=1, d_ff=16)
+    d_params = _params(draft, 4)
+    prompt = np.array([[1, 2, 3], [4, 5, 6]], np.int32)
+
+    out, stats = target.generate_speculative(
+        t_params, prompt, n_new=9, draft=draft, draft_params=d_params,
+        spec_k=3, temperature=0.9, seed=5, with_stats=True)
+    out = np.asarray(out)
+    assert out.shape == (2, 12)
+    np.testing.assert_array_equal(out[:, :3], prompt)
+    assert np.all((out >= 0) & (out < 17))
+    again = np.asarray(target.generate_speculative(
+        t_params, prompt, n_new=9, draft=draft, draft_params=d_params,
+        spec_k=3, temperature=0.9, seed=5))
+    np.testing.assert_array_equal(again, out)
+    assert stats["proposed"] >= stats["accepted"] >= 0
+    assert stats["rounds"] >= 1
+    assert stats["tokens_emitted"] == 2 * 9
+
+
+def test_sampled_device_preserves_target_distribution():
+    """THE speculative guarantee, for the on-device f32 rejection rule:
+    the rollout's marginal token distribution equals the target's own
+    temperature sampling. Empirical marginals at the first generated
+    positions over many seeded rollouts (B rows × N seeds), compared by
+    total-variation distance — the draft is a DIFFERENT model, so any
+    bias in accept/residual/bonus math would show up here."""
+    target = _model(vocab=7, d_model=16, n_layers=1, max_len=16)
+    t_params = _params(target, 8)
+    draft = _model(vocab=7, d_model=8, n_heads=2, n_layers=1, d_ff=16,
+                   max_len=16)
+    d_params = _params(draft, 9)
+    prompt = np.tile(np.array([[1, 2]], np.int32), (8, 1))
+    temp, n_new, n_seeds = 1.1, 3, 60
+
+    spec, plain = [], []
+    for s in range(n_seeds):
+        spec.append(np.asarray(target.generate_speculative(
+            t_params, prompt, n_new=n_new, draft=draft,
+            draft_params=d_params, spec_k=2, temperature=temp, seed=s)))
+        plain.append(np.asarray(target.generate(
+            t_params, prompt, n_new, temperature=temp, seed=10_000 + s)))
+    spec = np.concatenate(spec)    # [8*n_seeds, 2+n_new]
+    plain = np.concatenate(plain)
+    for j in range(2, 2 + n_new):
+        fs = np.bincount(spec[:, j], minlength=7) / len(spec)
+        fp = np.bincount(plain[:, j], minlength=7) / len(plain)
+        tv = 0.5 * np.abs(fs - fp).sum()
+        assert tv < 0.12, (j, tv, fs, fp)
+
+
+def test_sampled_host_oracle_path_still_works():
+    """host_loop=True forces the f64 host driver (the distributional
+    oracle the device rule is checked against) — keep it alive."""
+    target = _model()
+    t_params = _params(target, 3)
+    draft = _model(d_model=8, n_heads=2, n_layers=1, d_ff=16)
+    d_params = _params(draft, 4)
+    prompt = np.array([[1, 2, 3]], np.int32)
+    out = np.asarray(target.generate_speculative(
+        t_params, prompt, n_new=6, draft=draft, draft_params=d_params,
+        spec_k=2, temperature=1.0, seed=3, host_loop=True))
+    assert out.shape == (1, 9)
+    np.testing.assert_array_equal(out[:, :3], prompt)
+    assert np.all((out >= 0) & (out < 17))
