@@ -8,7 +8,12 @@ from .moe_tp import (
     moe_tp_specs,
     shard_moe_tp_params,
 )
-from .pipeline_lm import build_lm_pp_train_step, lm_pp_specs
+from .pipeline_lm import (
+    build_lm_pp_train_step,
+    build_lm_pp_tp_train_step,
+    lm_pp_specs,
+    lm_pp_tp_specs,
+)
 from .losses import resolve_accuracy, resolve_per_sample_loss
 from .optimizers import adam_compact, scale_by_adam_compact, to_optax
 from .lora import (
@@ -52,6 +57,8 @@ __all__ = [
     "LMFsdpLayout",
     "build_lm_fsdp_train_step",
     "build_lm_pp_train_step",
+    "build_lm_pp_tp_train_step",
+    "lm_pp_tp_specs",
     "lm_pp_specs",
     "build_moe_lm_tp_generate",
     "build_moe_lm_tp_train_step",

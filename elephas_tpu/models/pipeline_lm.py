@@ -361,6 +361,138 @@ def _pp_1f1b_grads(model, params, tokens, positions, targets, n_micro,
     return lacc, gacc
 
 
+def lm_pp_tp_specs(model: TransformerLM) -> Dict[str, P]:
+    """PartitionSpecs for the 3-D dp×pp×tp layout: block stacks shard
+    their leading layer dim over ``"pipe"`` AND their head/ffn dim over
+    ``"model"`` (the :func:`~.tensor_lm.tp_specs` plan per layer);
+    embeddings/final-norm/head replicate."""
+    from .tensor_lm import tp_specs
+
+    block_keys = set(model._block_keys())
+    tspecs = tp_specs(model)
+    specs: Dict[str, P] = {}
+    for k in model.param_shapes():
+        if k not in block_keys:
+            specs[k] = P()
+            continue
+        t = tuple(tspecs.get(k, P()))
+        specs[k] = P(PIPE_AXIS, *t[1:]) if t else P(PIPE_AXIS)
+    return specs
+
+
+def build_lm_pp_tp_train_step(model: TransformerLM, mesh: Mesh, optimizer,
+                              n_micro: int, attn: str = "flash"):
+    """Compile one REAL-LM 3-D training step on ``("data","pipe","model")``
+    (round 5 — replaces the toy ``TensorPipelineStack``-only composition
+    for transformer depth × width).
+
+    GPipe microbatches stream transformer blocks over ``"pipe"``
+    (:func:`~..parallel.pipeline.pipeline_apply`; the backward is the
+    reverse pipeline by transposition) while every block computes on
+    Megatron column/row shards over ``"model"``
+    (:func:`~.tensor_lm._tp_block`: attention by local head groups, the
+    classic two psums per layer through the ``identity_psum_grad`` /
+    ``psum_identity_grad`` operator pair). Batch shards over ``"data"``.
+    Embeddings/final-norm/head replicate (their gradients are identical
+    across ``"model"`` by the operator-pair argument and restored across
+    ``"pipe"`` with one psum — the GPipe convention); block gradients are
+    owned per (pipe, model) shard with no collective beyond the data
+    psum. Same contract as :func:`build_lm_pp_train_step`; params follow
+    :func:`lm_pp_tp_specs`. Trajectory equals the unpipelined replicated
+    oracle (``tests/models/test_pipeline_lm.py``).
+    """
+    from .tensor_lm import TP_AXIS, _tp_block, _validate_tp
+
+    if getattr(model, "n_experts", None):
+        raise NotImplementedError(
+            "dp×pp×tp covers the dense TransformerLM family")
+    if attn not in ("dense", "flash"):
+        raise ValueError(
+            f"attn={attn!r}: the pipelined LM keeps sequences whole — "
+            "use 'flash' (TPU) or 'dense'")
+    _validate_tp(model, mesh)
+    if PIPE_AXIS not in mesh.shape:
+        raise ValueError(
+            f"mesh must carry a {PIPE_AXIS!r} axis, got "
+            f"{dict(mesh.shape)}")
+    pp = mesh.shape[PIPE_AXIS]
+    dp = mesh.shape[DATA_AXIS]
+    if model.n_layers % pp:
+        raise ValueError(
+            f"n_layers {model.n_layers} not divisible by pipe axis {pp}")
+    if n_micro < 1:
+        raise ValueError(f"n_micro must be >= 1, got {n_micro}")
+
+    block_keys = set(model._block_keys())
+    pspecs = lm_pp_tp_specs(model)
+    sspecs = opt_state_specs(optimizer, model.param_shapes(), pspecs)
+    tok_spec = P(DATA_AXIS)
+
+    def step_impl(params, opt_state, tokens, positions, targets):
+        prank = jax.lax.axis_index(PIPE_AXIS)
+        ntok_total = float(tokens.shape[0] * tokens.shape[1] * dp)
+        B = tokens.shape[0]
+        if B % n_micro:
+            raise ValueError(
+                f"local batch {B} not divisible by n_micro={n_micro}")
+        mb = B // n_micro
+
+        def loss_fn(p):
+            from .tensor_lm import _tp_attend
+
+            h = model._embed(p, tokens, positions)
+            rope = model._rope_for(positions)
+            # row-uniform positions ⇒ microbatches share the first mb
+            # rows' rope (the pipeline contract)
+            rope_mb = None if rope is None else (rope[0][:mb],
+                                                 rope[1][:mb])
+            attend, tables = _tp_attend(model, attn, rope_mb, True)
+
+            def stage_fn(stage_params, x):
+                def one(hh, lp):
+                    hh, _ = _tp_block(model, hh, lp, rope_mb, attend,
+                                      grad_mode=True,
+                                      fused_rope=tables is not None)
+                    return hh, None
+
+                out, _ = jax.lax.scan(one, x, stage_params)
+                return out
+
+            lp_stage = {k: p[k] for k in block_keys}
+            h = pipeline_apply(stage_fn, lp_stage, h, n_micro)
+            h = model._norm_h(p, "lnf", h)
+            ce = _summed_xent(model._logits(p, h), targets)
+            return jnp.where(prank == pp - 1, ce / ntok_total, 0.0)
+
+        objective, grads = jax.value_and_grad(loss_fn)(params)
+        # block grads: owned per (pipe, model) shard; replicated params:
+        # identical across "model" (operator pair) — one PIPE psum
+        # restores replication, then everything psums over "data".
+        grads = {
+            k: jax.lax.psum(
+                g if k in block_keys else jax.lax.psum(g, PIPE_AXIS),
+                DATA_AXIS,
+            )
+            for k, g in grads.items()
+        }
+        loss = jax.lax.psum(jax.lax.psum(objective, PIPE_AXIS), DATA_AXIS)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = jax.tree_util.tree_map(
+            lambda prm, u: (prm + u).astype(prm.dtype), params, updates)
+        return params, opt_state, loss
+
+    step = jax.jit(
+        jax.shard_map(
+            step_impl, mesh=mesh,
+            in_specs=(pspecs, sspecs, tok_spec, tok_spec, tok_spec),
+            out_specs=(pspecs, sspecs, P()),
+            check_vma=False,
+        ),
+        donate_argnums=(0, 1),
+    )
+    return step, make_opt_init(optimizer, mesh, sspecs)
+
+
 def _edge_keys(model: TransformerLM):
     """The vocab-sized edge tensors ``shard_edges`` splits over the pipe
     axis: the token embedding, plus the untied head."""

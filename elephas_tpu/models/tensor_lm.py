@@ -198,16 +198,15 @@ def _tp_ffn(model: TransformerLM, lp, x_in, cd, tp_sum):
     return out
 
 
-def _tp_forward(model: TransformerLM, params, tokens, positions, attn: str,
-                grad_mode: bool):
-    """Full TP forward → (logits [B, T, V] f32, (ks, vs) local-head K/V
-    stacks [L, B, T, kvl, Dh])."""
-    h = model._embed(params, tokens, positions)
-    rope = model._rope_for(positions)
+def _tp_attend(model: TransformerLM, attn: str, rope, grad_mode: bool):
+    """Shared attend-dispatch closure for the TP builders (the dp×tp
+    forward and the pp×tp stage): flash on TPU (rope fused from
+    once-built tables under ``grad_mode`` — XLA cannot hoist them from a
+    scan body; inference callers need the pre-rotated k for the cache),
+    dense reference elsewhere, the model-wide window throughout. Returns
+    ``(attend, tables)`` — ``tables is not None`` ⇔ the caller must skip
+    its own rope rotation (``fused_rope``)."""
     on_tpu_flash = attn == "flash" and is_tpu_backend()
-    # Fused-rope tables build ONCE out here (same rationale as
-    # apply_with_aux: XLA cannot hoist them from the scan body). Training
-    # only — inference callers need the pre-rotated k for the cache.
     tables = None
     if rope is not None and on_tpu_flash and grad_mode:
         from ..ops.pallas_flash import make_rope_tables
@@ -224,6 +223,17 @@ def _tp_forward(model: TransformerLM, params, tokens, positions, attn: str,
         if on_tpu_flash:
             return flash_attention(q, k, v, causal=True, window=w)
         return attention_reference(q, k, v, causal=True, window=w)
+
+    return attend, tables
+
+
+def _tp_forward(model: TransformerLM, params, tokens, positions, attn: str,
+                grad_mode: bool):
+    """Full TP forward → (logits [B, T, V] f32, (ks, vs) local-head K/V
+    stacks [L, B, T, kvl, Dh])."""
+    h = model._embed(params, tokens, positions)
+    rope = model._rope_for(positions)
+    attend, tables = _tp_attend(model, attn, rope, grad_mode)
 
     def block(h, lp):
         h, kv = _tp_block(model, h, lp, rope, attend, grad_mode,

@@ -1374,19 +1374,25 @@ class TransformerLM:
         path, which the tests pin). On TPU ``decode_step`` uses the
         flash-decode kernel while ``decode_chunk`` uses a dense einsum; an
         exact tie in the target's top-2 logits could in principle resolve
-        differently between them. Dense family only — the MoE variant's
-        chunked verification would route tokens as one competing dispatch
-        group while its rollout routes per-position, breaking the
-        equality, so it is rejected below."""
+        differently between them. The MoE family participates when expert
+        capacity provably never binds (``capacity_factor >= n_experts`` —
+        the hf_import pin): chunked verification then routes every token
+        identically to per-position decode (see
+        ``MoETransformerLM._supports_speculative``); capacity-bound MoE
+        configs are rejected below because a binding capacity makes chunk
+        and per-position keep/drop decisions diverge."""
         if not self._supports_speculative:
             raise NotImplementedError(
-                "speculative decoding is supported for the dense "
-                "TransformerLM family only (MoE chunk routing differs "
-                "from its per-position decode routing)"
+                "speculative decoding needs chunk routing == per-position "
+                "routing: for the MoE family that holds only when expert "
+                "capacity never binds (capacity_factor * k >= n_experts — "
+                "the pin hf_import applies; raise capacity_factor, or use "
+                "the dense family)"
             )
         if not draft._supports_speculative:
             raise NotImplementedError(
-                "the draft model must be a dense TransformerLM"
+                "the draft model's routing must also be chunk-stable "
+                "(dense, or MoE with capacity_factor >= n_experts)"
             )
         prompt = jnp.asarray(prompt, jnp.int32)
         B, T0 = prompt.shape
@@ -1683,7 +1689,22 @@ class MoETransformerLM(TransformerLM):
     training objective.
     """
 
-    _supports_speculative = False  # chunk routing != per-position routing
+    @property
+    def _supports_speculative(self):
+        # Chunked verification routes a whole spec_k+1 chunk as ONE
+        # competing dispatch group while the rollout routes per position —
+        # keep/drop decisions could differ wherever expert capacity BINDS.
+        # An expert receives at most n claims per n-token group (each
+        # token claims it at most once), so capacity never binds iff
+        # cap(n) = ceil(cf·k·n/E) ≥ n for every n, i.e. cf·k ≥ E —
+        # exactly the pin models/hf_import.py applies for HF routing
+        # parity (cf = E/k). Then every (token, expert) claim is kept in
+        # BOTH formulations and the renormalized combine weights
+        # coincide, so chunk routing == per-position routing by
+        # construction and speculative decoding is exact (round 5;
+        # pinned in tests/models/test_speculative.py).
+        return (self.moe.capacity_factor * self.moe.k
+                >= self.moe.n_experts)
 
     def __init__(self, vocab: int, d_model: int, n_heads: int, n_layers: int,
                  d_ff: int, max_len: int, n_experts: int, k: int = 2,

@@ -176,13 +176,15 @@ def test_self_draft_leaves_no_cache_holes():
     assert stats["rounds"] == -(-(n_new - 1) // (spec_k + 1)), stats
 
 
-def test_moe_rejected():
+def test_moe_capacity_bound_rejected():
+    """Capacity-BOUND MoE configs still refuse (chunk routing could
+    diverge from per-position routing); the message says how to fix."""
     from elephas_tpu.models.transformer import MoETransformerLM
 
     moe = MoETransformerLM(vocab=17, d_model=16, n_heads=4, n_layers=1,
-                           d_ff=32, max_len=32, n_experts=4, k=1)
+                           d_ff=32, max_len=32, n_experts=4, k=1)  # cf 1.25
     dense = _model()
-    with pytest.raises(NotImplementedError, match="dense"):
+    with pytest.raises(NotImplementedError, match="capacity_factor"):
         moe.generate_speculative(
             {k: jnp.asarray(v) for k, v in moe.init().items()},
             np.zeros((1, 2), np.int32), n_new=2, draft=dense,
@@ -194,6 +196,73 @@ def test_moe_rejected():
             draft=moe,
             draft_params={k: jnp.asarray(v) for k, v in moe.init().items()},
         )
+
+
+def _moe_unbounded(**kw):
+    from elephas_tpu.models.transformer import MoETransformerLM
+
+    cfg = dict(vocab=17, d_model=16, n_heads=4, n_layers=2, d_ff=32,
+               max_len=48, n_experts=4, k=2, capacity_factor=4.0,
+               pos_encoding="rotary", norm="rmsnorm", activation="swiglu",
+               ffn_bias=False)
+    cfg.update(kw)
+    return MoETransformerLM(**cfg)
+
+
+@pytest.mark.parametrize("spec_k", [1, 3])
+def test_moe_greedy_speculative_equals_target_greedy(spec_k):
+    """Round 5: capacity-unbounded MoE targets speculate — chunk routing
+    == per-position routing by construction, so greedy output must equal
+    the MoE target's own rollout (dense draft)."""
+    target = _moe_unbounded()
+    t_params = {k: jnp.asarray(v) for k, v in target.init(seed=3).items()}
+    draft = _model(d_model=8, n_heads=2, n_layers=1, d_ff=16)
+    d_params = _params(draft, 4)
+    prompt = np.array([[1, 2, 3]], np.int32)
+    want = np.asarray(target.generate(t_params, prompt, 10))
+    got = np.asarray(target.generate_speculative(
+        t_params, prompt, 10, draft, d_params, spec_k=spec_k))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_moe_draft_for_dense_target():
+    """An unbounded MoE DRAFT proposes for a dense target."""
+    target = _model()
+    t_params = _params(target, 3)
+    draft = _moe_unbounded(d_model=16, n_layers=1)
+    d_params = {k: jnp.asarray(v) for k, v in draft.init(seed=5).items()}
+    prompt = np.array([[4, 5]], np.int32)
+    want = np.asarray(target.generate(t_params, prompt, 8))
+    got = np.asarray(target.generate_speculative(
+        t_params, prompt, 8, draft, d_params, spec_k=2))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_moe_self_draft_full_acceptance():
+    """MoE target drafting for itself: every round fully accepts and the
+    caches stay hole-free through the bonus path."""
+    target = _moe_unbounded()
+    t_params = {k: jnp.asarray(v) for k, v in target.init(seed=6).items()}
+    prompt = np.array([[1, 2], [3, 4]], np.int32)
+    want = np.asarray(target.generate(t_params, prompt, 9))
+    got, stats = target.generate_speculative(
+        t_params, prompt, 9, target, t_params, spec_k=3, with_stats=True)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert stats["accepted"] == stats["proposed"]
+
+
+def test_moe_sampled_speculative_contract():
+    target = _moe_unbounded()
+    t_params = {k: jnp.asarray(v) for k, v in target.init(seed=7).items()}
+    draft = _model(d_model=8, n_heads=2, n_layers=1, d_ff=16)
+    d_params = _params(draft, 8)
+    prompt = np.array([[1, 2, 3]], np.int32)
+    out = np.asarray(target.generate_speculative(
+        t_params, prompt, 8, draft, d_params, spec_k=2, temperature=0.9,
+        seed=4))
+    assert out.shape == (1, 11)
+    np.testing.assert_array_equal(out[:, :3], prompt)
+    assert np.all((out >= 0) & (out < 17))
 
 
 def test_speculative_validation():
@@ -367,3 +436,17 @@ def test_sampled_host_oracle_path_still_works():
     assert out.shape == (1, 9)
     np.testing.assert_array_equal(out[:, :3], prompt)
     assert np.all((out >= 0) & (out < 17))
+
+
+def test_moe_capacity_pin_is_exactly_the_boundary():
+    """The hf_import pin (cf = E/k, 'a slot for every token') is the
+    never-binds boundary: an imported Mixtral (E=8, k=2, cf=4) MUST
+    speculate; anything below refuses."""
+    from elephas_tpu.models.transformer import MoETransformerLM
+
+    kw = dict(vocab=17, d_model=16, n_heads=4, n_layers=1, d_ff=32,
+              max_len=32, n_experts=8, k=2, activation="swiglu",
+              norm="rmsnorm", ffn_bias=False)
+    assert MoETransformerLM(capacity_factor=4.0, **kw)._supports_speculative
+    assert not MoETransformerLM(capacity_factor=3.9,
+                                **kw)._supports_speculative
