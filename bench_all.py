@@ -114,8 +114,15 @@ def config2_mnist_cnn():
 
 
 def config3_imdb_lstm():
-    """ElephasEstimator pipeline on IMDB-shaped data (wall-clock incl.
-    compile — the one-shot DataFrame API flow)."""
+    """ElephasEstimator pipeline on IMDB-shaped data.
+
+    Two figures since round 5 (the config-2/6 marginal discipline applied
+    to the L5 skins): the one-shot wall-clock incl. compile (the honest
+    DataFrame-API first-use number), and the MARGINAL steady-state rate
+    from differencing fits at two epoch counts after per-geometry warmups
+    — per-fit fixed cost (compile, DataFrame conversion, weight
+    round-trips) cancels, leaving the compiled program's per-step rate.
+    """
     import jax
     import numpy as np
 
@@ -161,16 +168,71 @@ def config3_imdb_lstm():
     preds = np.array([r.prediction for r in rows])
     labels = np.array([r.label for r in rows])
     acc = float(((preds > 0.5) == (labels > 0.5)).mean())
+
+    # marginal steady-state: difference estimator fits at two epoch
+    # counts (each epoch count is its own compiled program — warm up
+    # both geometries first, then best-of-2)
+    e_lo, e_hi = 1, 1 + 2 * epochs
+
+    def best_est_fit(n_epochs, reps=2):
+        est.set_epochs(n_epochs)
+        Pipeline(stages=[est]).fit(df)  # warmup/compile this geometry
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            Pipeline(stages=[est]).fit(df)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_lo = best_est_fit(e_lo)
+    t_hi = best_est_fit(e_hi)
+    # same timer-noise floor _marginal_fit_sps enforces: a differenced
+    # wall below resolution must report None, not a fantasy rate
+    sps_marginal = (
+        n * (e_hi - e_lo) / (t_hi - t_lo)
+        if t_hi - t_lo >= _MARGINAL_FLOOR_S else None)
     log(f"config3 imdb-lstm pipeline: {n * epochs / dt:,.0f} samples/sec "
-        f"(incl. compile), acc {acc:.4f}")
+        f"(incl. compile); marginal steady-state "
+        + (f"{sps_marginal:,.0f} samples/sec" if sps_marginal
+           else "below timer floor")
+        + f"; acc {acc:.4f}")
     return {
         "samples_per_sec_incl_compile": round(n * epochs / dt, 1),
+        "samples_per_sec_marginal":
+            round(sps_marginal, 1) if sps_marginal else None,
         "test_accuracy": round(acc, 4),
     }
 
 
+_MARGINAL_FLOOR_S = 0.05  # differenced wall below this is timer noise
+
+
+def _marginal_fit_sps(m, fit_kwargs, n_samples, e_lo, e_hi, reps=2):
+    """Round-5 shared helper: marginal steady-state samples/sec from
+    differencing fits at two epoch counts (per-geometry warmups; per-fit
+    fixed cost cancels). Returns ``None`` when the differenced wall is
+    below the timer-noise floor — tiny-dataset fits can complete their
+    extra epochs faster than the measurement resolves, and a clamped
+    division would report a fantasy number."""
+    def best(n_epochs):
+        m.fit(epochs=n_epochs, **fit_kwargs)  # warmup/compile
+        b = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            m.fit(epochs=n_epochs, **fit_kwargs)
+            b = min(b, time.perf_counter() - t0)
+        return b
+
+    t_lo, t_hi = best(e_lo), best(e_hi)
+    if t_hi - t_lo < _MARGINAL_FLOOR_S:
+        return None
+    return n_samples * (e_hi - e_lo) / (t_hi - t_lo)
+
+
 def config4_mllib():
-    """SparkMLlibModel: Boston-shaped regression MSE + Iris accuracy."""
+    """SparkMLlibModel: Boston-shaped regression MSE + Iris accuracy —
+    one-shot wall incl. compile AND (round 5) the marginal steady-state
+    rate via the config-2/6 differencing discipline."""
     import jax
     import keras
     import numpy as np
@@ -223,19 +285,39 @@ def config4_mllib():
     acc = float(
         (np.asarray(mc.predict(xi)).argmax(1) == yi.astype(int)).mean()
     )
+    # marginal steady-state for both skins (fixed per-fit cost cancels)
+    sps_reg = _marginal_fit_sps(
+        m, dict(labeled_points=lp, batch_size=32, validation_split=0.0,
+                categorical=False), len(x), 1, 1 + 20 * epochs)
+    sps_cls = _marginal_fit_sps(
+        mc, dict(labeled_points=lpi, batch_size=16, validation_split=0.0,
+                 categorical=True, nb_classes=3), len(xi), 1,
+        1 + 20 * epochs)
     sc.stop()
-    log(f"config4 boston mse {mse:.4f} ({dt_reg:.1f}s), "
-        f"iris acc {acc:.4f} ({dt_cls:.1f}s), incl. compile")
+    fmt = lambda v: f"{v:,.0f} sps" if v else "below timer floor"
+    log(f"config4 boston mse {mse:.4f} ({dt_reg:.1f}s incl. compile; "
+        f"marginal {fmt(sps_reg)}), iris acc {acc:.4f} "
+        f"({dt_cls:.1f}s; marginal {fmt(sps_cls)})")
     return {
         "boston_mse_normalized": round(mse, 4),
         "boston_fit_seconds_incl_compile": round(dt_reg, 2),
+        "boston_samples_per_sec_marginal":
+            round(sps_reg, 1) if sps_reg else None,
         "iris_accuracy": round(acc, 4),
         "iris_fit_seconds_incl_compile": round(dt_cls, 2),
+        "iris_samples_per_sec_marginal":
+            round(sps_cls, 1) if sps_cls else None,
     }
 
 
 def config5_hyperparam():
-    """Distributed TPE search wall-clock (device-slice fan-out)."""
+    """Distributed TPE search wall-clock (device-slice fan-out).
+
+    Round 5 adds the marginal seconds/trial: differencing searches at two
+    ``max_evals`` budgets cancels the fixed setup (context, first-model
+    compile). Per-trial recompiles remain — the search space varies layer
+    sizes, so each trial IS a new geometry; the marginal figure prices a
+    trial's true cost, not the harness's."""
     from elephas_tpu import HyperParamModel
     from elephas_tpu.data import SparkContext
 
@@ -248,17 +330,26 @@ def config5_hyperparam():
     t0 = time.perf_counter()
     trials = hp.compute_trials(model=model, data=data, max_evals=evals)
     dt = time.perf_counter() - t0
+    e_hi = 3 * evals
+    t0 = time.perf_counter()
+    trials_hi = hp.compute_trials(model=model, data=data, max_evals=e_hi)
+    dt_hi = time.perf_counter() - t0
+    n_lo = len(trials)
+    n_hi = len(trials_hi)
+    marg_trial = (dt_hi - dt) / max(n_hi - n_lo, 1)
     sc.stop()
     ok = [t for t in trials if t["status"] == "ok"]
     best = min(t["loss"] for t in ok)
     devices = sorted({t["device"] for t in trials})
-    log(f"config5 search: {len(trials)} trials / {workers} workers in "
-        f"{dt:.1f}s (incl. compile), best loss {best:.4f}, "
-        f"devices {devices}")
+    log(f"config5 search: {n_lo} trials / {workers} workers in "
+        f"{dt:.1f}s (incl. compile); marginal {marg_trial:.2f} s/trial "
+        f"({n_hi - n_lo} extra trials in {dt_hi - dt:.1f}s); best loss "
+        f"{best:.4f}, devices {devices}")
     return {
-        "trials": len(trials),
+        "trials": n_lo,
         "workers": workers,
         "wall_seconds_incl_compile": round(dt, 2),
+        "marginal_seconds_per_trial": round(marg_trial, 2),
         "best_loss": round(best, 4),
         "distinct_devices": len(devices),
     }

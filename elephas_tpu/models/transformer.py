@@ -1375,7 +1375,7 @@ class TransformerLM:
         flash-decode kernel while ``decode_chunk`` uses a dense einsum; an
         exact tie in the target's top-2 logits could in principle resolve
         differently between them. The MoE family participates when expert
-        capacity provably never binds (``capacity_factor >= n_experts`` —
+        capacity provably never binds (``capacity_factor·k >= n_experts`` —
         the hf_import pin): chunked verification then routes every token
         identically to per-position decode (see
         ``MoETransformerLM._supports_speculative``); capacity-bound MoE
@@ -1392,7 +1392,7 @@ class TransformerLM:
         if not draft._supports_speculative:
             raise NotImplementedError(
                 "the draft model's routing must also be chunk-stable "
-                "(dense, or MoE with capacity_factor >= n_experts)"
+                "(dense, or MoE with capacity_factor * k >= n_experts)"
             )
         prompt = jnp.asarray(prompt, jnp.int32)
         B, T0 = prompt.shape
