@@ -223,6 +223,210 @@ def bench_lm(reps: int, overrides: dict | None = None):
     }
 
 
+def bench_moe(reps: int):
+    """Config-8 MoE LM training (bench_all.py's judged geometry): tokens/sec
+    + model-FLOPs MFU, measured by the MARGINAL method.
+
+    Returns a dict for the judged JSON line, or None when skipped (CPU
+    fallback — MFU against a CPU has no meaning; force with BENCH_MOE=1).
+
+    The MFU denominator counts MODEL FLOPs only — attention, router, and
+    the k ACTIVE experts per token (swiglu-aware); dispatch is overhead,
+    not useful FLOPs, so this MFU is directly comparable to config 8's.
+    Timing uses the marginal method from the MLP metric: best-of-reps for
+    a ``steps``-step loop AND a 1-step loop, then difference, so per-loop
+    fixed overhead (relay launch, host sync) cancels out of the per-step
+    rate instead of inflating it.
+    """
+    import numpy as np
+
+    import jax
+
+    gate = os.environ.get("BENCH_MOE", "auto")
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if gate == "0" or (gate == "auto" and not on_tpu):
+        log("moe bench: skipped (not on TPU; set BENCH_MOE=1 to force)")
+        return None
+
+    from elephas_tpu.models import (
+        MoETransformerLM, adam_compact, build_lm_train_step, build_mesh_sp,
+        make_lm_batches, shard_lm_batch,
+    )
+
+    D, L, H, F = 1024, 4, 8, 4096
+    E, K = 8, 2
+    V, T, B = 8192, 1024, 4
+    steps = int(os.environ.get("BENCH_MOE_STEPS", 10))
+    model = MoETransformerLM(
+        vocab=V, d_model=D, n_heads=H, n_layers=L, d_ff=F, max_len=T,
+        n_experts=E, k=K, capacity_factor=1.25, compute_dtype="bfloat16",
+        pos_encoding="rotary", tie_embeddings=True, activation="swiglu",
+        norm="rmsnorm", ffn_bias=False, param_dtype="bfloat16",
+    )
+    mesh = build_mesh_sp(data=1, seq=1)
+    step, opt_init = build_lm_train_step(model, mesh, adam_compact(1e-3),
+                                         attn="flash")
+    params = model.shard_params(mesh, model.init(seed=0))
+    state = opt_init(params)
+    rows = np.random.default_rng(0).integers(0, V, size=(B, T + 1))
+    batch = shard_lm_batch(mesh, *make_lm_batches(rows))
+
+    log(f"moe bench: d{D} L{L} E{E} k{K} F{F} T{T} B{B} bf16 swiglu "
+        "(compiling...)")
+    for _ in range(2):
+        params, state, loss = step(params, state, *batch)
+    float(loss)
+
+    def best_loop(n_steps: int) -> float:
+        nonlocal params, state
+        best = float("inf")
+        for rep in range(max(1, reps)):
+            t0 = time.perf_counter()
+            for _ in range(n_steps):
+                params, state, loss = step(params, state, *batch)
+            last = float(loss)  # host sync flushes the relay
+            dt = time.perf_counter() - t0
+            assert np.isfinite(last), last
+            log(f"moe rep {rep} ({n_steps} steps): {dt:.3f}s")
+            best = min(best, dt)
+        return best
+
+    t_full = best_loop(steps)
+    marginal = False
+    step_s = t_full / steps
+    if steps > 1:
+        t_one = best_loop(1)
+        if t_full > t_one:
+            step_s = (t_full - t_one) / (steps - 1)
+            marginal = True
+        else:
+            log("moe marginal differencing degenerate; reporting raw")
+
+    tok_s = B * T / step_s
+    # model FLOPs/token (fwd, x3 train): attention qkvo + causal dots,
+    # router D*E, k active swiglu experts (3 matmuls each), tied head
+    attn = L * (2 * (2 * D * D + 2 * D * D) + 4 * D * (T + 1) / 2)
+    ffn = L * (2 * D * E + K * 3 * 2 * D * F)
+    flops_tok = 3.0 * (attn + ffn + 2 * D * V)
+    peak = peak_bf16_flops(jax.devices()[0])
+    mfu = flops_tok * tok_s / peak if peak else None
+    log(f"moe bench: {tok_s:,.0f} tok/s, "
+        f"{flops_tok * tok_s / 1e12:.1f} TF/s model flops"
+        + (f", MFU {mfu * 100:.1f}%" if mfu else " (peak unknown)"))
+    return {
+        "tokens_per_sec": round(tok_s, 1),
+        "model_flops_mfu": round(mfu, 4) if mfu else None,
+        "step_ms": round(step_s * 1e3, 2),
+        "flops_per_token_model_only": round(flops_tok),
+        "marginal": marginal,
+        "config": f"d{D}xL{L}xE{E}k{K}xF{F}xT{T}xB{B}-swiglu-bf16-bf16params",
+    }
+
+
+def bench_serving(reps: int):
+    """Continuous-batching ServingEngine vs sequential generation.
+
+    CPU-runnable (the judged ratio is relative, not an MFU): the SAME
+    greedy requests run (a) one-at-a-time through ``TransformerLM.generate``
+    and (b) through a ``ServingEngine`` at concurrency ``slots``. Reports
+    the engine's aggregate decode throughput, p50/p95 TTFT and mean batch
+    occupancy from the engine's own metrics, and ``vs_sequential`` — the
+    aggregate-throughput ratio the acceptance bar reads (≥ 2×). Greedy
+    decoding makes the two sides token-identical, which is asserted, so
+    the speedup is never bought with different outputs. Skip with
+    BENCH_SERVING=0; geometry via BENCH_SERVE_{DMODEL,LAYERS,VOCAB,SLOTS,
+    PROMPT,NEW,REQUESTS}.
+    """
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    if os.environ.get("BENCH_SERVING", "1") == "0":
+        log("serving bench: skipped (BENCH_SERVING=0)")
+        return None
+
+    from elephas_tpu.models import TransformerLM
+    from elephas_tpu.serving import ServingEngine
+
+    def knob(name, default):
+        return int(os.environ.get(f"BENCH_SERVE_{name.upper()}", default))
+
+    d_model = knob("dmodel", 256)
+    n_layers = knob("layers", 4)
+    n_heads = max(1, d_model // 64)
+    vocab = knob("vocab", 2048)
+    slots = knob("slots", 8)
+    prompt_len = knob("prompt", 16)
+    max_new = knob("new", 32)
+    n_req = knob("requests", slots)
+    model = TransformerLM(
+        vocab=vocab, d_model=d_model, n_heads=n_heads, n_layers=n_layers,
+        d_ff=4 * d_model, max_len=prompt_len + max_new,
+        pos_encoding="rotary", tie_embeddings=True,
+    )
+    params = {k: jnp.asarray(v) for k, v in model.init(seed=0).items()}
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, vocab, size=(prompt_len,)).astype(np.int32)
+               for _ in range(n_req)]
+    log(f"serving bench: d{d_model} L{n_layers} V{vocab} x{n_req} requests "
+        f"(p{prompt_len}+n{max_new}) through {slots} slots (compiling...)")
+
+    # -- sequential baseline: one request at a time, whole-rollout generate
+    seq_out = [np.asarray(model.generate(params, p[None], max_new))
+               [0, prompt_len:] for p in prompts[:1]]  # warmup/compile
+    best_seq = float("inf")
+    for rep in range(max(1, reps)):
+        t0 = time.perf_counter()
+        seq_out = [np.asarray(model.generate(params, p[None], max_new))
+                   [0, prompt_len:] for p in prompts]
+        dt = time.perf_counter() - t0
+        log(f"serving rep {rep}: sequential {dt:.3f}s")
+        best_seq = min(best_seq, dt)
+    seq_tok_s = n_req * max_new / best_seq
+
+    # -- engine: compile the insert/decode programs once, then time fresh
+    # engines (the jitted kernels are module-level, so the programs carry
+    # over; a fresh engine isolates queue/metric state per rep)
+    warm = ServingEngine(model, params, n_slots=slots)
+    for p in prompts:
+        warm.submit(p, max_new)
+    warm.drain(max_steps=100_000)
+
+    best_eng, snap, eng_out = float("inf"), None, None
+    for rep in range(max(1, reps)):
+        eng = ServingEngine(model, params, n_slots=slots)
+        t0 = time.perf_counter()
+        ids = [eng.submit(p, max_new) for p in prompts]
+        fin = eng.drain(max_steps=100_000)
+        dt = time.perf_counter() - t0
+        log(f"serving rep {rep}: engine {dt:.3f}s")
+        if dt < best_eng:
+            best_eng, snap = dt, eng.snapshot()
+            eng_out = [np.asarray(fin[r].tokens) for r in ids]
+    for got, want in zip(eng_out, seq_out):
+        np.testing.assert_array_equal(got, want)  # same tokens, faster
+
+    eng_tok_s = n_req * max_new / best_eng
+    ttft = snap["requests"]["ttft_s"]
+    ratio = eng_tok_s / seq_tok_s
+    log(f"serving bench: {eng_tok_s:,.0f} tok/s aggregate vs "
+        f"{seq_tok_s:,.0f} sequential ({ratio:.2f}x), "
+        f"TTFT p50 {ttft['p50'] * 1e3:.0f}ms p95 {ttft['p95'] * 1e3:.0f}ms, "
+        f"occupancy {snap['engine']['batch_occupancy']:.2f}")
+    return {
+        "agg_tokens_per_sec": round(eng_tok_s, 1),
+        "sequential_tokens_per_sec": round(seq_tok_s, 1),
+        "vs_sequential": round(ratio, 2),
+        "ttft_p50_ms": round(ttft["p50"] * 1e3, 2),
+        "ttft_p95_ms": round(ttft["p95"] * 1e3, 2),
+        "batch_occupancy": snap["engine"]["batch_occupancy"],
+        "concurrency": slots,
+        "requests": n_req,
+        "config": f"d{d_model}xL{n_layers}xH{n_heads}-V{vocab}"
+                  f"-p{prompt_len}n{max_new}",
+    }
+
+
 def make_model(input_dim, nb_classes):
     import keras
 
@@ -367,6 +571,19 @@ def main():
     # second, enriched line follows — consumers read the last line.
     print(json.dumps(result), flush=True)
 
+    # -- serving phase: continuous batching vs sequential (CPU-runnable) --
+    # Runs FIRST among the enrichment phases: it is the one judged entry
+    # that works on the CPU fallback, so it must land even if a later
+    # TPU-only phase hangs the relay.
+    try:
+        serving = bench_serving(reps)
+    except Exception as e:
+        log(f"serving bench failed: {type(e).__name__}: {e}")
+        serving = None
+    if serving is not None:
+        result["serving"] = serving
+        print(json.dumps(result), flush=True)
+
     # -- LM phase: FLOPs-accounted tokens/sec + MFU on the same chip ------
     # Judged config = the measured-best geometry (d2048/B4); the historical
     # d1024/B8 geometry is re-measured as lm_alt so round-over-round step
@@ -390,6 +607,16 @@ def main():
             if alt is not None:
                 result["lm_alt"] = alt
                 print(json.dumps(result))
+
+    # -- MoE phase: config-8 geometry, model-FLOPs MFU (TPU-gated) --------
+    try:
+        moe = bench_moe(reps)
+    except Exception as e:
+        log(f"moe bench failed: {type(e).__name__}: {e}")
+        moe = None
+    if moe is not None:
+        result["moe"] = moe
+        print(json.dumps(result), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,39 @@
+"""Version-portability shims for jax APIs this repo straddles.
+
+The codebase targets the modern ``jax.shard_map`` entry point (top-level,
+``check_vma=`` keyword); older runtimes (jax 0.4.x) ship the same
+transform as ``jax.experimental.shard_map.shard_map`` with the
+replication-check keyword spelled ``check_rep=``. Every shard_map call in
+the repo goes through :func:`shard_map` below so the whole sharded stack
+(training engines, tensor/expert/pipeline parallel layers, sharded
+generate, the serving engine) runs unmodified on either line.
+"""
+
+from __future__ import annotations
+
+import jax
+
+if hasattr(jax, "shard_map"):                     # jax >= 0.6
+    _shard_map = jax.shard_map
+    _CHECK_KW = "check_vma"
+else:                                             # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _CHECK_KW = "check_rep"
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the replication-check flag normalized to the
+    modern ``check_vma`` spelling (mapped to ``check_rep`` on 0.4.x)."""
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **{_CHECK_KW: check_vma})
+
+
+if hasattr(jax.lax, "axis_size"):                 # jax >= 0.5
+    axis_size = jax.lax.axis_size
+else:
+    def axis_size(axis_name):
+        """Static size of a mapped axis. ``psum`` of a Python constant is
+        constant-folded to ``size * value`` at trace time — the idiom
+        ``jax.lax.axis_size`` replaced — so this stays a concrete int
+        usable in trace-time ``if``s."""
+        return jax.lax.psum(1, axis_name)

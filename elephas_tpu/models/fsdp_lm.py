@@ -39,6 +39,7 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from ..compat import shard_map
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -403,7 +404,7 @@ def build_lm_fsdp_train_step(model: TransformerLM, mesh: Mesh, optimizer,
         return chunks, opt_state, loss
 
     step = jax.jit(
-        jax.shard_map(
+        shard_map(
             step_impl, mesh=mesh,
             in_specs=(chunk_specs, sspecs, tok_spec, tok_spec, tok_spec),
             out_specs=(chunk_specs, sspecs, P()),

@@ -25,6 +25,7 @@ from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+from ..compat import shard_map
 import numpy as np
 
 from .transformer import (
@@ -305,7 +306,7 @@ def build_lora_lm_train_step(model: TransformerLM, mesh: Mesh, optimizer,
         pspecs = replicated_like(params)
         sspecs = replicated_like(jax.eval_shape(opt.init, params))
         return jax.jit(
-            jax.shard_map(
+            shard_map(
                 make_step_impl(lora_mask(params), opt), mesh=mesh,
                 in_specs=(pspecs, sspecs, tok_spec, tok_spec, tok_spec),
                 out_specs=(pspecs, sspecs, P()),

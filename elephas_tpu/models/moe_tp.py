@@ -37,6 +37,7 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+from ..compat import axis_size, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.flash_attention import flash_attention
@@ -143,7 +144,7 @@ def _moe_tp_block(model, h, lp, rope, attend, grad_mode: bool):
     cd = model.compute_dtype
     B, T, D = h.shape
     Dh = model.d_model // model.n_heads
-    tp = jax.lax.axis_size(TP_AXIS)
+    tp = axis_size(TP_AXIS)
     if grad_mode:
         enter = lambda x: identity_psum_grad(x, TP_AXIS)
         tp_sum = lambda x: psum_identity_grad(x, TP_AXIS)
@@ -268,7 +269,7 @@ def build_moe_lm_tp_train_step(model: MoETransformerLM, mesh: Mesh,
         return params, opt_state, loss
 
     jit_step = jax.jit(
-        jax.shard_map(
+        shard_map(
             step_impl, mesh=mesh,
             in_specs=(pspecs, sspecs, tok_spec, tok_spec, tok_spec),
             out_specs=(pspecs, sspecs, P()),
@@ -402,7 +403,7 @@ def build_moe_lm_tp_generate(model: MoETransformerLM, mesh: Mesh,
         geom = (B, T0, int(n_new))
         if geom not in programs:
             programs[geom] = jax.jit(
-                jax.shard_map(
+                shard_map(
                     functools.partial(_gen_impl, total, Tc),
                     mesh=mesh,
                     in_specs=(pspecs, P(DATA_AXIS, None), P()),

@@ -36,6 +36,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from ..compat import axis_size, shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.mesh import DATA_AXIS
@@ -236,7 +237,7 @@ def build_lm_pp_train_step(model: TransformerLM, mesh: Mesh, optimizer,
         return params, opt_state, loss
 
     step = jax.jit(
-        jax.shard_map(
+        shard_map(
             step_impl, mesh=mesh,
             in_specs=(pspecs, sspecs, tok_spec, tok_spec, tok_spec),
             out_specs=(pspecs, sspecs, P()),
@@ -272,7 +273,7 @@ def _pp_1f1b_grads(model, params, tokens, positions, targets, n_micro,
     (edge-param grads are nonzero only on their owning rank here, and
     the pipe psum restores the replicated invariant).
     """
-    p = jax.lax.axis_size(PIPE_AXIS)
+    p = axis_size(PIPE_AXIS)
     rank = jax.lax.axis_index(PIPE_AXIS)
     B, T = tokens.shape
     mb = B // n_micro
@@ -482,7 +483,7 @@ def build_lm_pp_tp_train_step(model: TransformerLM, mesh: Mesh, optimizer,
         return params, opt_state, loss
 
     step = jax.jit(
-        jax.shard_map(
+        shard_map(
             step_impl, mesh=mesh,
             in_specs=(pspecs, sspecs, tok_spec, tok_spec, tok_spec),
             out_specs=(pspecs, sspecs, P()),

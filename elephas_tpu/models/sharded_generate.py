@@ -50,11 +50,12 @@ the single-device path computes, so greedy sharded generation reproduces
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, Optional
+from typing import Any, Dict, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from ..compat import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.flash_decode import (
     aligned_cache_length,
@@ -68,6 +69,7 @@ from .transformer import (
     _period_ungroup,
     _rope_angles,
     _rope_rotate,
+    select_slot_tokens,
     select_tokens,
 )
 
@@ -77,6 +79,181 @@ def _local_cache_len(total: int, sp: int) -> int:
     flash-decode kernel never pads (a pad would recopy the slice in HBM
     every step)."""
     return aligned_cache_length(-(-total // sp))
+
+
+def _check_mesh_and_specs(model: TransformerLM, mesh: Mesh) -> None:
+    """Shared build-time validation for every sharded inference builder:
+    the mesh must carry the (``"data"``, ``"seq"``) axes and params may be
+    replicated or sharded over ``"seq"`` only (the MoE expert stacks)."""
+    for name, spec in model.specs().items():
+        for ax in spec:
+            axes = ax if isinstance(ax, tuple) else (ax,)
+            for a in axes:
+                if a not in (None, SEQ_AXIS):
+                    raise NotImplementedError(
+                        f"sharded generate shards over {SEQ_AXIS!r}; param "
+                        f"{name!r} has spec {spec}"
+                    )
+    if DATA_AXIS not in mesh.shape or SEQ_AXIS not in mesh.shape:
+        raise ValueError(
+            f"mesh must carry ({DATA_AXIS!r}, {SEQ_AXIS!r}) axes, got "
+            f"{dict(mesh.shape)}"
+        )
+    n_experts = getattr(model, "n_experts", None)
+    sp = mesh.shape[SEQ_AXIS]
+    if n_experts is not None and n_experts % sp:
+        # same build-time clarity the training builder gives — otherwise
+        # this surfaces as a cryptic all_to_all divisibility error later
+        raise ValueError(
+            f"n_experts={n_experts} not divisible by seq axis size {sp}"
+        )
+
+
+def _merged_decode_attention(qg, kc, vc, pos_local, Tl, window):
+    """Local flash-decode partial + logsumexp merge over "seq".
+
+    ``pos_local`` is a scalar or per-row ``[B]`` (the serving engine's
+    slots sit at independent depths). ``window`` is THIS layer's sliding
+    window (static; None = full). The local kernel masks ``slot ≤
+    pos_local`` and ``slot > pos_local − w``; since both slot and pos
+    share the rank's global offset ``r·Tl``, that IS the global window
+    mask — including for ranks whose slice the window has partially left,
+    which pass their true (past-the-end) ``pos_local`` so the lower bound
+    stays global. Ranks with nothing visible — not yet reached, or wholly
+    expired — clamp pos into valid kernel range and drop out of the merge
+    with −inf lse (per ROW when pos is per-row)."""
+    if window is None:
+        pos_cl = jnp.clip(pos_local, 0, Tl - 1)
+        invalid = pos_local < 0
+    else:
+        w = int(window)
+        # upper clamp keeps ≥1 visible slot (valid arithmetic);
+        # genuinely expired ranks are overridden below anyway
+        pos_cl = jnp.clip(pos_local, 0, Tl + w - 2)
+        invalid = (pos_local < 0) | (pos_local - w + 1 >= Tl)
+    o_r, lse_r = decode_attention_lse(qg, kc, vc, pos_cl,
+                                      window=window)
+    invalid = jnp.asarray(invalid)
+    if invalid.ndim == 1:                        # per-row → [B, 1, 1]
+        invalid = invalid[:, None, None]
+    lse_r = jnp.where(invalid, -jnp.inf, lse_r)
+    m = jax.lax.pmax(lse_r, SEQ_AXIS)
+    w_r = jnp.exp(lse_r - m)                     # [B, Hkv, G]
+    num = jax.lax.psum(w_r[..., None] * o_r, SEQ_AXIS)
+    den = jax.lax.psum(w_r, SEQ_AXIS)
+    return num / den[..., None]                  # [B, Hkv, G, Dh]
+
+
+def _owner_write(c, new, idx, is_owner, per_row: bool):
+    """Owner-masked statically-shaped cache write: ``new`` ``[B, Hkv, 1,
+    Dh]`` into ``c`` ``[B, Hkv, Tl, Dh]`` at time ``idx``. The owner rank
+    writes the new row; everyone else re-writes its current row with
+    itself — one ``[B, Hkv, 1, Dh]`` gather keeps the update statically
+    shaped without copying the whole slice through a select. ``idx`` /
+    ``is_owner`` are scalars, or per-row ``[B]`` (vmapped — serving slots
+    advance independently, so different rows can have different owner
+    ranks)."""
+    if not per_row:
+        cur = jax.lax.dynamic_slice_in_dim(c, idx, 1, axis=2)
+        return jax.lax.dynamic_update_slice_in_dim(
+            c, jnp.where(is_owner, new, cur), idx, axis=2)
+
+    def row(cb, nb, ib, ob):
+        cur = jax.lax.dynamic_slice_in_dim(cb, ib, 1, axis=1)
+        return jax.lax.dynamic_update_slice_in_dim(
+            cb, jnp.where(ob, nb, cur), ib, axis=1)
+
+    return jax.vmap(row)(c, new, idx, is_owner)
+
+
+def _decode_step_sharded(model: TransformerLM, params, token, p,
+                         kcache, vcache, Tl: int):
+    """One merged decode step on the local batch/cache shards.
+
+    ``token [B_local]`` at absolute position ``p`` — a traced scalar (the
+    lockstep generate rollout) or per-row ``[B_local]`` (the serving
+    engine's slots each sit at their own depth); ``kcache/vcache
+    [L, B_local, Hkv, Tl, Dh]``. Mirrors ``TransformerLM.decode_step``
+    with the attention and cache write swapped for their sharded forms
+    (including the per-layer window period scan).
+    """
+    B = token.shape[0]
+    H = model.n_heads
+    Hkv = model.n_kv_heads
+    Dh = model.d_model // H
+    cd = model.compute_dtype
+    p = jnp.asarray(p)
+    per_row = p.ndim == 1
+    r = jax.lax.axis_index(SEQ_AXIS)
+    pos_local = p - r * Tl                       # scalar or [B]
+    is_owner = (pos_local >= 0) & (pos_local < Tl)
+    idx = jnp.clip(pos_local, 0, Tl - 1)
+    if per_row:
+        # [B] → broadcastable against the [B, Hkv, 1, Dh] row updates
+        is_owner_w = is_owner[:, None, None, None]
+    else:
+        is_owner_w = is_owner
+
+    pos_b = jnp.broadcast_to(p, (B,))
+    h = model._embed(params, token, pos_b)       # [B, D]
+    if model.pos_encoding == "rotary":
+        r_cos, r_sin = _rope_angles(pos_b, Dh, model.rope_theta)
+        r_cos, r_sin = r_cos[:, None, :], r_sin[:, None, :]
+
+    def one_layer(h, lp, kc, vc, window):
+        # kc/vc [B, Hkv, Tl, Dh]; ``window`` static for this layer
+        x = model._norm_h(lp, "ln1", h).astype(cd)
+        q = model._attn_proj(lp, "q", x).reshape(B, H, Dh)
+        k_new = model._attn_proj(lp, "k", x).reshape(B, Hkv, 1, Dh)
+        v_new = model._attn_proj(lp, "v", x).reshape(B, Hkv, 1, Dh)
+        if model.pos_encoding == "rotary":
+            q = _rope_rotate(q, r_cos, r_sin)
+            k_new = _rope_rotate(k_new, r_cos[:, None], r_sin[:, None])
+        kc = _owner_write(kc, k_new, idx, is_owner_w, per_row)
+        vc = _owner_write(vc, v_new, idx, is_owner_w, per_row)
+        qg = q.reshape(B, Hkv, H // Hkv, Dh)
+        a = _merged_decode_attention(qg, kc, vc, pos_local, Tl, window)
+        a = a.astype(cd).reshape(B, H, Dh)
+        h = h + model._attn_proj(lp, "o", a.reshape(B, model.d_model))
+        x = model._norm_h(lp, "ln2", h).astype(cd)
+        # Non-"dense" tag: the MoE variant's experts dispatch over the
+        # LIVE seq axis (all_to_all against the local expert shards —
+        # every rank routes its identical replicated tokens, so the
+        # combined outputs stay replicated); the dense FFN ignores the
+        # tag entirely.
+        out, _ = model._ffn(lp, x[:, None, :], "ring", SEQ_AXIS,
+                            ep_groups=1)
+        return h + out[:, 0].astype(cd), kc, vc
+
+    pp = model._window_period()
+
+    def block(h, inputs):
+        lp, kc, vc = inputs
+        if pp == 1:
+            h, kc, vc = one_layer(h, lp, kc, vc, model.attn_windows[0])
+            return h, (kc, vc)
+        kcs, vcs = [], []
+        for g in range(pp):
+            h, kc_g, vc_g = one_layer(
+                h, {k: v[g] for k, v in lp.items()}, kc[g], vc[g],
+                model.attn_windows[g])
+            kcs.append(kc_g)
+            vcs.append(vc_g)
+        return h, (jnp.stack(kcs), jnp.stack(vcs))
+
+    lps = {k: params[k] for k in model._block_keys()}
+    kcache_s, vcache_s = kcache, vcache
+    if pp > 1:
+        lps = _period_group(lps, pp)
+        kcache_s = _period_group(kcache, pp)
+        vcache_s = _period_group(vcache, pp)
+    h, (kc_new, vc_new) = jax.lax.scan(
+        block, h, (lps, kcache_s, vcache_s))
+    if pp > 1:
+        kc_new = _period_ungroup(kc_new, model.n_layers)
+        vc_new = _period_ungroup(vc_new, model.n_layers)
+    h = model._norm_h(params, "lnf", h)
+    return model._logits(params, h), kc_new, vc_new
 
 
 def build_lm_generate(model: TransformerLM, mesh: Mesh,
@@ -94,26 +271,13 @@ def build_lm_generate(model: TransformerLM, mesh: Mesh,
     """
     # Params may be replicated or sharded over THIS program's "seq" axis
     # (the MoE expert stacks) — anything else has no home here.
-    for name, spec in model.specs().items():
-        for ax in spec:
-            axes = ax if isinstance(ax, tuple) else (ax,)
-            for a in axes:
-                if a not in (None, SEQ_AXIS):
-                    raise NotImplementedError(
-                        f"sharded generate shards over {SEQ_AXIS!r}; param "
-                        f"{name!r} has spec {spec}"
-                    )
     # Sliding windows (uniform or per-layer): the cache stays
     # horizon-sharded (memory already divided by sp), each rank masks its
     # local partial on GLOBAL window arithmetic — positions past a rank's
     # slice end keep the offset identity (see _merged_decode_attention) —
     # and wholly-expired ranks drop out of the logsumexp merge with −inf
     # weight, exactly like not-yet-reached ranks.
-    if DATA_AXIS not in mesh.shape or SEQ_AXIS not in mesh.shape:
-        raise ValueError(
-            f"mesh must carry ({DATA_AXIS!r}, {SEQ_AXIS!r}) axes, got "
-            f"{dict(mesh.shape)}"
-        )
+    _check_mesh_and_specs(model, mesh)
     if top_k is not None and not 1 <= int(top_k) <= model.vocab:
         raise ValueError(
             f"top_k must be in [1, vocab={model.vocab}], got {top_k}"
@@ -122,133 +286,11 @@ def build_lm_generate(model: TransformerLM, mesh: Mesh,
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
 
     sp = mesh.shape[SEQ_AXIS]
-    n_experts = getattr(model, "n_experts", None)
-    if n_experts is not None and n_experts % sp:
-        # same build-time clarity the training builder gives — otherwise
-        # this surfaces as a cryptic all_to_all divisibility error later
-        raise ValueError(
-            f"n_experts={n_experts} not divisible by seq axis size {sp}"
-        )
     dp = mesh.shape[DATA_AXIS]
-    H = model.n_heads
     Hkv = model.n_kv_heads
-    Dh = model.d_model // H
+    Dh = model.d_model // model.n_heads
     cd = model.compute_dtype
     programs: Dict[Any, Any] = {}
-
-    def _merged_decode_attention(qg, kc, vc, pos_local, Tl, window):
-        """Local flash-decode partial + logsumexp merge over "seq".
-
-        ``window`` is THIS layer's sliding window (static; None = full).
-        The local kernel masks ``slot ≤ pos_local`` and ``slot >
-        pos_local − w``; since both slot and pos share the rank's global
-        offset ``r·Tl``, that IS the global window mask — including for
-        ranks whose slice the window has partially left, which pass their
-        true (past-the-end) ``pos_local`` so the lower bound stays
-        global. Ranks with nothing visible — not yet reached, or wholly
-        expired — clamp pos into valid kernel range and drop out of the
-        merge with −inf lse."""
-        if window is None:
-            pos_cl = jnp.clip(pos_local, 0, Tl - 1)
-            invalid = pos_local < 0
-        else:
-            w = int(window)
-            # upper clamp keeps ≥1 visible slot (valid arithmetic);
-            # genuinely expired ranks are overridden below anyway
-            pos_cl = jnp.clip(pos_local, 0, Tl + w - 2)
-            invalid = (pos_local < 0) | (pos_local - w + 1 >= Tl)
-        o_r, lse_r = decode_attention_lse(qg, kc, vc, pos_cl,
-                                          window=window)
-        lse_r = jnp.where(invalid, -jnp.inf, lse_r)
-        m = jax.lax.pmax(lse_r, SEQ_AXIS)
-        w_r = jnp.exp(lse_r - m)                     # [B, Hkv, G]
-        num = jax.lax.psum(w_r[..., None] * o_r, SEQ_AXIS)
-        den = jax.lax.psum(w_r, SEQ_AXIS)
-        return num / den[..., None]                  # [B, Hkv, G, Dh]
-
-    def _decode_step_sharded(params, token, p, kcache, vcache, Tl):
-        """One merged decode step on the local batch/cache shards.
-
-        ``token [B_local]`` at absolute position ``p`` (traced scalar);
-        ``kcache/vcache [L, B_local, Hkv, Tl, Dh]``. Mirrors
-        ``TransformerLM.decode_step`` with the attention and cache write
-        swapped for their sharded forms (including the per-layer window
-        period scan).
-        """
-        B = token.shape[0]
-        r = jax.lax.axis_index(SEQ_AXIS)
-        pos_local = p - r * Tl
-        is_owner = (pos_local >= 0) & (pos_local < Tl)
-        idx = jnp.clip(pos_local, 0, Tl - 1)
-
-        pos_b = jnp.broadcast_to(p, (B,))
-        h = model._embed(params, token, pos_b)       # [B, D]
-        if model.pos_encoding == "rotary":
-            r_cos, r_sin = _rope_angles(pos_b, Dh, model.rope_theta)
-            r_cos, r_sin = r_cos[:, None, :], r_sin[:, None, :]
-
-        def one_layer(h, lp, kc, vc, window):
-            # kc/vc [B, Hkv, Tl, Dh]; ``window`` static for this layer
-            x = model._norm_h(lp, "ln1", h).astype(cd)
-            q = model._attn_proj(lp, "q", x).reshape(B, H, Dh)
-            k_new = model._attn_proj(lp, "k", x).reshape(B, Hkv, 1, Dh)
-            v_new = model._attn_proj(lp, "v", x).reshape(B, Hkv, 1, Dh)
-            if model.pos_encoding == "rotary":
-                q = _rope_rotate(q, r_cos, r_sin)
-                k_new = _rope_rotate(k_new, r_cos[:, None], r_sin[:, None])
-            # Owner writes the new row; everyone else re-writes its current
-            # row with itself — one [B, Hkv, 1, Dh] gather keeps the update
-            # statically shaped without copying the whole slice through a
-            # select.
-            cur_k = jax.lax.dynamic_slice_in_dim(kc, idx, 1, axis=2)
-            cur_v = jax.lax.dynamic_slice_in_dim(vc, idx, 1, axis=2)
-            kc = jax.lax.dynamic_update_slice_in_dim(
-                kc, jnp.where(is_owner, k_new, cur_k), idx, axis=2)
-            vc = jax.lax.dynamic_update_slice_in_dim(
-                vc, jnp.where(is_owner, v_new, cur_v), idx, axis=2)
-            qg = q.reshape(B, Hkv, H // Hkv, Dh)
-            a = _merged_decode_attention(qg, kc, vc, pos_local, Tl, window)
-            a = a.astype(cd).reshape(B, H, Dh)
-            h = h + model._attn_proj(lp, "o", a.reshape(B, model.d_model))
-            x = model._norm_h(lp, "ln2", h).astype(cd)
-            # Non-"dense" tag: the MoE variant's experts dispatch over the
-            # LIVE seq axis (all_to_all against the local expert shards —
-            # every rank routes its identical replicated tokens, so the
-            # combined outputs stay replicated); the dense FFN ignores the
-            # tag entirely.
-            out, _ = model._ffn(lp, x[:, None, :], "ring", SEQ_AXIS,
-                                ep_groups=1)
-            return h + out[:, 0].astype(cd), kc, vc
-
-        pp = model._window_period()
-
-        def block(h, inputs):
-            lp, kc, vc = inputs
-            if pp == 1:
-                h, kc, vc = one_layer(h, lp, kc, vc, model.attn_windows[0])
-                return h, (kc, vc)
-            kcs, vcs = [], []
-            for g in range(pp):
-                h, kc_g, vc_g = one_layer(
-                    h, {k: v[g] for k, v in lp.items()}, kc[g], vc[g],
-                    model.attn_windows[g])
-                kcs.append(kc_g)
-                vcs.append(vc_g)
-            return h, (jnp.stack(kcs), jnp.stack(vcs))
-
-        lps = {k: params[k] for k in model._block_keys()}
-        kcache_s, vcache_s = kcache, vcache
-        if pp > 1:
-            lps = _period_group(lps, pp)
-            kcache_s = _period_group(kcache, pp)
-            vcache_s = _period_group(vcache, pp)
-        h, (kc_new, vc_new) = jax.lax.scan(
-            block, h, (lps, kcache_s, vcache_s))
-        if pp > 1:
-            kc_new = _period_ungroup(kc_new, model.n_layers)
-            vc_new = _period_ungroup(vc_new, model.n_layers)
-        h = model._norm_h(params, "lnf", h)
-        return model._logits(params, h), kc_new, vc_new
 
     def _gen_impl(total: int, Tl: int, params, prompt, key):
         """The per-rank program: local prompt ``[B_local, T0]``."""
@@ -293,7 +335,7 @@ def build_lm_generate(model: TransformerLM, mesh: Mesh,
         def step(carry, t):
             buf, kcache, vcache, token, key = carry
             logits, kcache, vcache = _decode_step_sharded(
-                params, token, t, kcache, vcache, Tl
+                model, params, token, t, kcache, vcache, Tl
             )
             key, kt = jax.random.split(key)
             nxt = select_tokens(logits, kt, temperature, top_k, top_p,
@@ -327,7 +369,7 @@ def build_lm_generate(model: TransformerLM, mesh: Mesh,
         if geom not in programs:
             pspecs = model.specs()  # replicated; MoE experts over "seq"
             programs[geom] = jax.jit(
-                jax.shard_map(
+                shard_map(
                     functools.partial(_gen_impl, total, Tl),
                     mesh=mesh,
                     in_specs=(pspecs, P(DATA_AXIS, None), P()),
@@ -339,3 +381,137 @@ def build_lm_generate(model: TransformerLM, mesh: Mesh,
         return programs[geom](params, prompt, key)
 
     return generate_fn
+
+
+class ServingOps(NamedTuple):
+    """The sharded program pair the serving engine drives (plus the cache
+    factory matching their layout). Signatures are identical to the
+    engine's single-device kernels, so ``ServingEngine`` swaps them in
+    without touching its loop."""
+
+    init_cache: Any   # () -> {"k"/"v": [L, S, Hkv, capacity, Dh]} placed
+    insert: Any       # (params, cache, tokens[1,Tb], t_last, slot) -> (last[V], cache)
+    decode: Any       # (params, cache, tok[S], pos[S], temps[S], keys[S,2]) -> (tok[S], cache)
+    max_len: int
+    capacity: int     # cache time axis = sp · aligned(ceil(max_len / sp))
+
+
+def build_serving_ops(model: TransformerLM, mesh: Mesh, n_slots: int,
+                      max_len: Optional[int] = None) -> ServingOps:
+    """Compile the serving engine's two device programs over ``mesh``:
+    SLOTS shard over ``"data"`` (each data rank owns ``n_slots/dp``
+    contiguous slot rows) and the KV cache time axis over ``"seq"`` —
+    per-chip cache memory drops by ``dp × sp`` while the driver loop stays
+    the single-device one.
+
+    **Insert** mirrors ``_gen_impl``'s prefill-then-slice: the padded
+    prompt ``[1, Tb]`` prefills replicated into a FULL-capacity transient
+    K/V buffer (every seq rank then slices exactly ``[r·Tl, (r+1)·Tl)`` —
+    no clamping, so no aliasing case), and each data rank owner-masks the
+    write into its local slot row: the owner replaces the whole row, every
+    other rank rewrites one of its rows with itself (statically shaped —
+    the same trick as the decode step's owner write). Ranks past the
+    prompt span write the transient buffer's zeros, wiping the previous
+    occupant wholesale.
+
+    **Decode** is ``_decode_step_sharded`` with PER-ROW positions (each
+    slot at its own depth, free slots parked at 0) + per-slot selection;
+    sampling runs replicated on every seq rank from identical merged
+    logits and identical per-slot keys, so ranks stay in lockstep with no
+    broadcast — ``row_offset`` folding is unnecessary because every slot
+    carries its own key.
+
+    One decode program total; one insert program per prompt-length bucket
+    (``t_last``/``slot`` stay traced).
+    """
+    _check_mesh_and_specs(model, mesh)
+    if model._ring_cache:
+        raise NotImplementedError(
+            "serving needs a linear (horizon) cache; all-windowed models "
+            "allocate rolling buffers (see TransformerLM.prefill_slot)"
+        )
+    sp = mesh.shape[SEQ_AXIS]
+    dp = mesh.shape[DATA_AXIS]
+    if n_slots % dp:
+        raise ValueError(
+            f"n_slots={n_slots} not divisible by data axis size {dp}")
+    max_len = int(model.max_len if max_len is None else max_len)
+    Tl = _local_cache_len(max_len, sp)
+    capacity = sp * Tl
+    L = model.n_layers
+    Hkv = model.n_kv_heads
+    Dh = model.d_model // model.n_heads
+    cd = model.compute_dtype
+    cspec = P(None, DATA_AXIS, None, SEQ_AXIS, None)
+    cache_specs = {"k": cspec, "v": cspec}
+    pspecs = model.specs()
+
+    def init_cache():
+        z = jnp.zeros((L, n_slots, Hkv, capacity, Dh), cd)
+        sh = NamedSharding(mesh, cspec)
+        return {"k": jax.device_put(z, sh), "v": jax.device_put(z, sh)}
+
+    def _insert_impl(params, cache, tokens, t_last, slot):
+        # local cache [L, S_local, Hkv, Tl, Dh]; tokens [1, Tb] replicated
+        S_local = cache["k"].shape[1]
+        r_seq = jax.lax.axis_index(SEQ_AXIS)
+        r_data = jax.lax.axis_index(DATA_AXIS)
+        tmp = {
+            "k": jnp.zeros((L, 1, Hkv, capacity, Dh), cd),
+            "v": jnp.zeros((L, 1, Hkv, capacity, Dh), cd),
+        }
+        logits, tmp = model.prefill(params, tokens, tmp, ffn_tag="ring")
+        new_k = jax.lax.dynamic_slice_in_dim(tmp["k"], r_seq * Tl, Tl,
+                                             axis=3)
+        new_v = jax.lax.dynamic_slice_in_dim(tmp["v"], r_seq * Tl, Tl,
+                                             axis=3)
+        slot_local = slot - r_data * S_local
+        own = (slot_local >= 0) & (slot_local < S_local)
+        idx = jnp.clip(slot_local, 0, S_local - 1)
+        out = {}
+        for n, new in (("k", new_k), ("v", new_v)):
+            cur = jax.lax.dynamic_slice_in_dim(cache[n], idx, 1, axis=1)
+            out[n] = jax.lax.dynamic_update_slice_in_dim(
+                cache[n], jnp.where(own, new, cur), idx, axis=1)
+        last = jax.lax.dynamic_index_in_dim(logits[0], t_last, axis=0,
+                                            keepdims=False)
+        return last, out
+
+    def _decode_impl(params, cache, tokens, pos, temps, keys):
+        # local: tokens/pos/temps [S_local], keys [S_local, 2]
+        logits, kc, vc = _decode_step_sharded(
+            model, params, tokens, pos, cache["k"], cache["v"], Tl)
+        toks = select_slot_tokens(logits, pos + 1, temps, keys)
+        return toks, {"k": kc, "v": vc}
+
+    insert_programs: Dict[int, Any] = {}
+
+    def insert(params, cache, tokens, t_last, slot):
+        Tb = int(tokens.shape[1])
+        if Tb not in insert_programs:
+            insert_programs[Tb] = jax.jit(
+                shard_map(
+                    _insert_impl,
+                    mesh=mesh,
+                    in_specs=(pspecs, cache_specs, P(None, None), P(), P()),
+                    out_specs=(P(), cache_specs),
+                    check_vma=False,
+                )
+            )
+        return insert_programs[Tb](
+            params, cache, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(t_last, jnp.int32), jnp.asarray(slot, jnp.int32))
+
+    decode = jax.jit(
+        shard_map(
+            _decode_impl,
+            mesh=mesh,
+            in_specs=(pspecs, cache_specs, P(DATA_AXIS), P(DATA_AXIS),
+                      P(DATA_AXIS), P(DATA_AXIS, None)),
+            out_specs=(P(DATA_AXIS), cache_specs),
+            check_vma=False,
+        )
+    )
+
+    return ServingOps(init_cache=init_cache, insert=insert, decode=decode,
+                      max_len=max_len, capacity=capacity)

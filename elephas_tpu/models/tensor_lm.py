@@ -46,6 +46,7 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+from ..compat import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.flash_attention import flash_attention
@@ -284,7 +285,7 @@ def build_lm_tp_train_step(model: TransformerLM, mesh: Mesh, optimizer,
         return params, opt_state, loss
 
     jit_step = jax.jit(
-        jax.shard_map(
+        shard_map(
             step_impl, mesh=mesh,
             in_specs=(pspecs, sspecs, tok_spec, tok_spec, tok_spec),
             out_specs=(pspecs, sspecs, P()),
@@ -426,7 +427,7 @@ def build_lm_tp_generate(model: TransformerLM, mesh: Mesh,
         geom = (B, T0, int(n_new))
         if geom not in programs:
             programs[geom] = jax.jit(
-                jax.shard_map(
+                shard_map(
                     functools.partial(_gen_impl, total, Tc),
                     mesh=mesh,
                     in_specs=(pspecs, P(DATA_AXIS, None), P()),

@@ -35,6 +35,7 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+from ..compat import axis_size, shard_map
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -105,6 +106,25 @@ def nucleus_mask(logits, top_p: float):
     keep = cum_before < float(top_p)
     rows = jnp.arange(logits.shape[0])[:, None]
     return jnp.zeros(logits.shape, bool).at[rows, sort_ix].set(keep)
+
+
+def select_slot_tokens(logits, out_pos, temps, keys):
+    """Per-SLOT token selection for the serving engine: row ``i`` of
+    ``logits`` ``[S, V]`` is greedy iff ``temps[i] <= 0`` (matching
+    :func:`select_tokens`' convention), else sampled from
+    ``softmax(logits_i / temps_i)`` with key ``fold_in(keys[i],
+    out_pos[i])`` — ``out_pos`` is the absolute position the emitted token
+    will occupy. Position-keyed folding makes a request's draw stream a
+    function of ``(seed, position)`` alone: the same request produces the
+    same tokens whatever slot it lands in and whatever else is co-batched,
+    and the prefill's first token and every decode step share one rule.
+    ``temps`` is TRACED (``[S]`` f32), not static — one compiled program
+    serves any mix of greedy and sampled requests."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-6)[:, None]
+    sk = jax.vmap(jax.random.fold_in)(keys, out_pos)
+    sampled = jax.vmap(jax.random.categorical)(sk, scaled).astype(jnp.int32)
+    return jnp.where(temps > 0, sampled, greedy)
 
 
 def _summed_xent(logits, targets):
@@ -277,6 +297,47 @@ def _prefill_jit(model, params, prompt, length: int, chunk: int):
                          model.init_cache(B, length, chunk=chunk))
 
 
+def spec_round_accept(pt, pd_draft, d_toks, u):
+    """One speculative round's acceptance math (the distribution-preserving
+    rejection rule), as a pure traced function → ``(n, resid)``.
+
+    ``pt`` ``[B, k+1, V]`` target probabilities over the verify chunk,
+    ``pd_draft`` ``[B, k, V]`` the draft's proposal distributions,
+    ``d_toks`` ``[B, k]`` the proposals, ``u`` ``[B, k]`` the acceptance
+    uniforms. Proposal ``i`` is accepted while ``u_i < min(1,
+    p_t(d_i)/p_d(d_i))``; ``n`` is the accepted-prefix length and ``resid``
+    the distribution the correction token must be drawn from: the clamped
+    normalized residual ``(p_t − p_d)+`` at the first rejection, or —
+    expressed uniformly by padding ``pd`` with a zero row at index ``k`` so
+    the residual at the bonus slot IS ``p_t`` — the target's own
+    distribution after a fully-accepted round.
+
+    Split out of :func:`_spec_rollout_device` so the exact closed-form
+    emission-distribution test (``tests/models/test_speculative.py``) can
+    marginalize the uniforms and the residual resample analytically against
+    THE code the compiled rollout runs — a mutation of the residual clamp
+    or the bonus-slot padding fails that test, not just a loose TV smoke.
+    """
+    B, spec_k = d_toks.shape
+    pd = jnp.concatenate(
+        [pd_draft, jnp.zeros((B, 1, pt.shape[-1]), jnp.float32)], axis=1)
+    pt_d = jnp.take_along_axis(
+        pt[:, :spec_k], d_toks[..., None], axis=-1)[..., 0]
+    pd_d = jnp.take_along_axis(
+        pd[:, :spec_k], d_toks[..., None], axis=-1)[..., 0]
+    ratio = pt_d / jnp.maximum(pd_d, 1e-20)          # [B, spec_k]
+    accept = (u < jnp.minimum(ratio, 1.0)).astype(jnp.int32)
+    n = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)  # [B]
+    # residual at the stop slot (p_t itself at the bonus slot — pd's zero
+    # padding row makes the formula uniform)
+    ptn = jnp.take_along_axis(pt, n[:, None, None], axis=1)[:, 0]  # [B, V]
+    pdn = jnp.take_along_axis(pd, n[:, None, None], axis=1)[:, 0]
+    resid = jnp.maximum(ptn - pdn, 0.0)
+    z = jnp.sum(resid, axis=-1, keepdims=True)
+    resid = jnp.where(z > 0, resid / jnp.maximum(z, 1e-30), ptn)
+    return n, resid
+
+
 @partial(jax.jit, static_argnames=("target", "draft", "spec_k", "total",
                                    "sampled"))
 def _spec_rollout_device(target, draft, params, draft_params, t_cache,
@@ -348,25 +409,9 @@ def _spec_rollout_device(target, draft, params, draft_params, t_cache,
         if sampled:
             pt = jax.nn.softmax(vl.astype(jnp.float32) * inv_t,
                                 axis=-1)                 # [B, k+1, V]
-            pd = jnp.concatenate(
-                [jnp.transpose(d_pd, (1, 0, 2)),
-                 jnp.zeros((B, 1, pt.shape[-1]), jnp.float32)], axis=1)
-            pt_d = jnp.take_along_axis(
-                pt[:, :spec_k], d_toks[..., None], axis=-1)[..., 0]
-            pd_d = jnp.take_along_axis(
-                pd[:, :spec_k], d_toks[..., None], axis=-1)[..., 0]
-            ratio = pt_d / jnp.maximum(pd_d, 1e-20)      # [B, spec_k]
             u = jax.random.uniform(ka, (B, spec_k), jnp.float32)
-            accept = (u < jnp.minimum(ratio, 1.0)).astype(jnp.int32)
-            n = jnp.sum(jnp.cumprod(accept, axis=1), axis=1)  # [B]
-            # residual at the stop slot (p_t itself at the bonus slot —
-            # pd's zero padding row makes the formula uniform)
-            ptn = jnp.take_along_axis(pt, n[:, None, None],
-                                      axis=1)[:, 0]      # [B, V]
-            pdn = jnp.take_along_axis(pd, n[:, None, None], axis=1)[:, 0]
-            resid = jnp.maximum(ptn - pdn, 0.0)
-            z = jnp.sum(resid, axis=-1, keepdims=True)
-            resid = jnp.where(z > 0, resid / jnp.maximum(z, 1e-30), ptn)
+            n, resid = spec_round_accept(
+                pt, jnp.transpose(d_pd, (1, 0, 2)), d_toks, u)
             corr = jax.random.categorical(
                 kc, jnp.log(jnp.maximum(resid, 1e-30)),
                 axis=-1).astype(jnp.int32)
@@ -465,6 +510,28 @@ def write_prompt_cache(kc, vc, ks, vs, windowed: bool):
                 vc.at[:, :, :, slots].set(vs[:, :, :, T0 - Tc:]))
     return (jax.lax.dynamic_update_slice_in_dim(kc, ks, 0, axis=3),
             jax.lax.dynamic_update_slice_in_dim(vc, vs, 0, axis=3))
+
+
+def cache_gather_slot(cache, slot):
+    """Slice one batch row ``slot`` (traced int) out of a KV cache
+    ``{"k"/"v": [L, B, Hkv, T, Dh]}`` → the same dict with ``B == 1``.
+    The batch axis of a serving cache is the SLOT axis (one row per
+    multiplexed request — ``serving/cache.py``); gather + scatter keep
+    per-slot prefill a pure function over the shared buffers."""
+    return {
+        n: jax.lax.dynamic_slice_in_dim(c, slot, 1, axis=1)
+        for n, c in cache.items()
+    }
+
+
+def cache_scatter_slot(cache, slot, slot_cache):
+    """Inverse of :func:`cache_gather_slot`: write the ``B == 1`` slice
+    ``slot_cache`` back into batch row ``slot`` of ``cache``."""
+    return {
+        n: jax.lax.dynamic_update_slice_in_dim(c, slot_cache[n], slot,
+                                               axis=1)
+        for n, c in cache.items()
+    }
 
 
 def _cache_update_rows(cache, new, pos, per_row: bool):
@@ -1045,6 +1112,36 @@ class TransformerLM:
         cache = {"k": ck, "v": cv}
         h = self._norm_h(params, "lnf", h)
         return self._logits(params, h), cache
+
+    def prefill_slot(self, params, tokens, slot, cache):
+        """Prompt ingestion into ONE batch row of a multi-slot cache: run
+        :meth:`decode_chunk` over ``tokens`` ``[1, T0]`` at positions
+        ``0..T0-1`` against slot ``slot``'s (traced int) rows of ``cache``
+        ``{"k"/"v": [L, S, Hkv, T, Dh]}`` → ``(logits [1, T0, V], cache)``.
+
+        The serving engine's prefill-insert primitive
+        (``serving/cache.py``): a new request lands in a free slot without
+        touching the other slots' state, and the chunked cached forward is
+        exactly a prefill when it starts at position 0 (pinned against the
+        teacher-forced forward in ``tests/models/test_speculative.py``).
+        ``tokens`` may be right-padded past the real prompt (bucketed
+        compile reuse): pad positions write K/V the decode loop overwrites
+        before any query attends them — the same staleness-repair invariant
+        speculative decoding relies on — and their logits are garbage the
+        caller must not sample from (take row ``T0_real − 1``).
+
+        Rolling (all-windowed) caches are refused: slot rows there are
+        ring buffers whose chunk-margin bookkeeping is per-rollout, not
+        per-slot (``serving/cache.py`` documents the restriction)."""
+        if self._ring_cache:
+            raise NotImplementedError(
+                "prefill_slot needs a linear (horizon) cache; all-windowed "
+                "models allocate rolling buffers — serve those with at "
+                "least one full-attention layer, or without slot batching"
+            )
+        slot_cache = cache_gather_slot(cache, slot)
+        logits, slot_cache = self.decode_chunk(params, tokens, 0, slot_cache)
+        return logits, cache_scatter_slot(cache, slot, slot_cache)
 
     def decode_step(self, params, token, pos, cache):
         """One cached decode step: ``token`` ``[B]`` int at absolute
@@ -1804,11 +1901,11 @@ class MoETransformerLM(TransformerLM):
         }
         if attn != "dense":
             flat = x.reshape(B * T, self.d_model)
-            # jax.lax.axis_size is static at trace time: on a size-1 axis
+            # axis_size (compat shim) is static at trace time: on a size-1 axis
             # the all_to_alls are identities and the per-shard dispatch
             # group is the whole local block, so the requested
             # single-device executor is exactly equivalent there.
-            if jax.lax.axis_size(seq_axis) == 1 and self.moe_dispatch in (
+            if axis_size(seq_axis) == 1 and self.moe_dispatch in (
                     "gmm", "ragged", "onehot"):
                 if self.moe_dispatch == "gmm":
                     y, aux = self.moe.apply_gmm(moe_params, flat)
@@ -2020,7 +2117,7 @@ def build_lm_train_step(model: TransformerLM, mesh: Mesh, optimizer,
         return params, opt_state, loss
 
     jit_step = jax.jit(
-        jax.shard_map(
+        shard_map(
             step_impl, mesh=mesh,
             in_specs=(pspecs, sspecs, tok_spec, tok_spec, tok_spec),
             out_specs=(pspecs, sspecs, P()),
@@ -2055,7 +2152,7 @@ def build_lm_eval_step(model: TransformerLM, mesh: Mesh, attn: str = "ring"):
         ) / ntok_total
 
     jit_eval = jax.jit(
-        jax.shard_map(
+        shard_map(
             eval_impl, mesh=mesh,
             in_specs=(pspecs, tok_spec, tok_spec, tok_spec),
             out_specs=P(),

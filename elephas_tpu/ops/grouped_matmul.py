@@ -45,6 +45,9 @@ try:  # pallas import kept lazy-tolerant like ops.pallas_ops
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    if not hasattr(pltpu, "CompilerParams"):  # jax 0.4.x spells it TPU-
+        pltpu.CompilerParams = pltpu.TPUCompilerParams
+
     _HAVE_PALLAS = True
 except Exception:  # pragma: no cover
     _HAVE_PALLAS = False

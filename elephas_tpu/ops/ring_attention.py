@@ -28,6 +28,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from ..compat import axis_size, shard_map
 
 from ..parallel.mesh import DATA_AXIS
 from .flash_attention import fold_softmax_block, repeat_kv_heads
@@ -83,7 +84,7 @@ def _ring_attention_local(q, k, v, causal: bool, axis_name: str,
     spanning any number of shard boundaries are exact."""
     if window is not None and not causal:
         raise ValueError("window requires causal attention")
-    p = jax.lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     b, tq, h, d = q.shape
     tk = k.shape[1]
@@ -161,7 +162,7 @@ def _ring_flash_local(q, k, v, causal: bool, axis_name: str,
     PARTIAL fall back to one materialized banded-score fold (the kernel's
     static window mask cannot express a traced cross-block offset).
     """
-    p = jax.lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     from .pallas_flash import flash_attention_with_lse
 
@@ -306,7 +307,7 @@ def sharded_seq_attention(tag: str, local_fn, mesh, axis_name: str,
         if len(_COMPILED) >= 16:  # bound the executable cache
             _COMPILED.pop(next(iter(_COMPILED)))
         fn = jax.jit(
-            jax.shard_map(
+            shard_map(
                 partial(local_fn, causal=causal, axis_name=axis_name,
                         window=window),
                 mesh=mesh,

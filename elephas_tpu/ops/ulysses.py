@@ -27,6 +27,7 @@ from functools import partial
 
 import jax
 
+from ..compat import axis_size
 from ..parallel.mesh import DATA_AXIS
 from .flash_attention import flash_attention, repeat_kv_heads
 from .ring_attention import sharded_seq_attention
@@ -46,7 +47,7 @@ def _ulysses_local(q, k, v, causal: bool, axis_name: str, window=None):
     skipping) applies unchanged."""
     if window is not None and not causal:
         raise ValueError("window requires causal attention")
-    p = jax.lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     h = q.shape[2]
     if k.shape[2] % p:
         k = repeat_kv_heads(k, h)

@@ -48,6 +48,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+from ..compat import shard_map
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -493,7 +494,7 @@ class CompiledTrainer:
             _, out = jax.lax.scan(step, None, xb)
             return out.reshape((S * B,) + out.shape[2:])
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             impl, mesh=self.mesh, in_specs=(P(), P(), P(DATA_AXIS)),
             out_specs=P(DATA_AXIS), check_vma=False,
         )
@@ -517,7 +518,7 @@ class CompiledTrainer:
             w_sum = jnp.maximum(jax.lax.psum(wsum, DATA_AXIS), 1e-9)
             return loss_sum / w_sum, acc_sum / w_sum
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             impl, mesh=self.mesh,
             in_specs=(P(), P(), P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS)),
             out_specs=(P(), P()), check_vma=False,
@@ -774,20 +775,20 @@ class CompiledTrainer:
                 # stacks and opt_stack are consumed and re-returned
                 donate = (0, 1, 4)
 
-            shard_fit = jax.shard_map(
+            shard_fit = shard_map(
                 fit_carry, mesh=mesh, in_specs=in_specs,
                 out_specs=(pspec_rep, pspec_rep, pspec_data, pspec_rep,
                            pspec_data, pspec_data),
                 check_vma=False,
             )
-            shard_opt_init = jax.shard_map(
+            shard_opt_init = shard_map(
                 opt_init_impl, mesh=mesh, in_specs=(pspec_rep,),
                 out_specs=pspec_data, check_vma=False,
             )
             return (jax.jit(shard_fit, donate_argnums=donate),
                     jax.jit(shard_opt_init))
 
-        shard_fit = jax.shard_map(
+        shard_fit = shard_map(
             fit_impl,
             mesh=mesh,
             in_specs=(
@@ -798,7 +799,7 @@ class CompiledTrainer:
             out_specs=(pspec_rep, pspec_rep, pspec_data, pspec_rep),
             check_vma=False,
         )
-        shard_opt_init = jax.shard_map(
+        shard_opt_init = shard_map(
             opt_init_impl, mesh=mesh, in_specs=(pspec_rep,),
             out_specs=pspec_data, check_vma=False,
         )
@@ -893,7 +894,7 @@ class CompiledTrainer:
         pspec_data = P(DATA_AXIS)
         # One shared optimizer state: replicated in AND out (unlike the
         # per-worker stacks of the local-training schedules).
-        shard_fit = jax.shard_map(
+        shard_fit = shard_map(
             fit_impl,
             mesh=mesh,
             in_specs=(
@@ -904,7 +905,7 @@ class CompiledTrainer:
             out_specs=(pspec_rep, pspec_rep, pspec_rep, pspec_rep),
             check_vma=False,
         )
-        shard_opt_init = jax.shard_map(
+        shard_opt_init = shard_map(
             opt_init_impl, mesh=mesh, in_specs=(pspec_rep,),
             out_specs=pspec_rep, check_vma=False,
         )

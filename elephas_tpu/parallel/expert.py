@@ -35,6 +35,7 @@ from typing import Any, Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+from ..compat import shard_map
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -895,7 +896,7 @@ def build_ep_train_step(model: MoEFeedForward, mesh: Mesh, optimizer,
         return params, opt_state, loss
 
     step = jax.jit(
-        jax.shard_map(
+        shard_map(
             step_impl, mesh=mesh,
             in_specs=(pspecs, sspecs, token_spec, token_spec),
             out_specs=(pspecs, sspecs, P()),

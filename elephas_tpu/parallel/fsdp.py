@@ -41,6 +41,7 @@ from typing import Any, Callable, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+from ..compat import shard_map
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -164,7 +165,7 @@ def build_fsdp_train_step(apply_fn: Callable, shapes: Dict[str, Tuple[int, ...]]
         return chunks, opt_state, loss
 
     step = jax.jit(
-        jax.shard_map(
+        shard_map(
             step_impl, mesh=mesh,
             in_specs=(chunk_spec, sspecs, data_spec, data_spec),
             out_specs=(chunk_spec, sspecs, P()),

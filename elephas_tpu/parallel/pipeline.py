@@ -34,6 +34,7 @@ from typing import Any, Callable, Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+from ..compat import axis_size, shard_map
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -73,7 +74,7 @@ def pipeline_apply(stage_fn: Callable, stage_params, x, n_micro: int,
     the reverse pipeline; don't-care ramp/drain outputs receive zero
     cotangent through the output mask.
     """
-    p = jax.lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     rank = jax.lax.axis_index(axis_name)
     b = x.shape[0]
     if b % n_micro:
@@ -242,7 +243,7 @@ def build_staged_train_step(model, mesh: Mesh, optimizer, per_sample_loss,
 
     def step_impl(params, opt_state, x, y):
         prank = jax.lax.axis_index(PIPE_AXIS)
-        psize = jax.lax.axis_size(PIPE_AXIS)
+        psize = axis_size(PIPE_AXIS)
 
         def loss_fn(p):
             y_pred = model.apply(p, x, n_micro)
@@ -267,7 +268,7 @@ def build_staged_train_step(model, mesh: Mesh, optimizer, per_sample_loss,
         return params, opt_state, loss
 
     step = jax.jit(
-        jax.shard_map(
+        shard_map(
             step_impl, mesh=mesh,
             in_specs=(pspecs, sspecs, data_spec, data_spec),
             out_specs=(pspecs, sspecs, P()),

@@ -42,6 +42,7 @@ from typing import Any, Dict, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+from ..compat import shard_map
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -298,7 +299,7 @@ def build_tp_train_step(model: TensorParallelMLP, mesh: Mesh, optimizer,
         return params, opt_state, loss
 
     step = jax.jit(
-        jax.shard_map(
+        shard_map(
             step_impl, mesh=mesh,
             in_specs=(pspecs, sspecs, data_spec, data_spec),
             out_specs=(pspecs, sspecs, P()),

@@ -1,0 +1,51 @@
+"""Continuous-batching LM serving (the request layer over the decode kernels).
+
+EXTENSION BEYOND THE REFERENCE (whose serving story is a driver-local
+``model.predict`` — SURVEY.md §2.5) and beyond this repo's own inference
+entry points, every one of which processes exactly ONE request end-to-end
+(``TransformerLM.generate``, ``generate_speculative``,
+``build_lm_generate``). The north star serves heavy traffic: that takes a
+layer that multiplexes many concurrent requests of mixed lengths through
+one compiled decode program, admitting new work as old work finishes —
+continuous batching — instead of batching only requests that arrive
+together and padding them to a common horizon.
+
+The split mirrors the repo's driver-orchestrates/compiled-workers shape:
+
+- :mod:`~elephas_tpu.serving.cache` — ``SlotKVCache``: one fixed
+  ``[L, slots, Hkv, T, Dh]`` KV buffer whose batch axis is the SLOT axis;
+  a request prefill-inserts into a free slot (``prefill_slot`` →
+  ``decode_chunk``), decodes in place, and releases the slot on finish.
+- :mod:`~elephas_tpu.serving.scheduler` — bounded FIFO+priority admission
+  queue (reject-with-reason backpressure) and the per-iteration
+  prefill-vs-decode decision.
+- :mod:`~elephas_tpu.serving.engine` — ``ServingEngine``: ``submit() →
+  request_id``, ``step()``, ``drain()``, per-token streaming callbacks,
+  greedy or temperature sampling per request; one batched
+  ``decode_step`` over all active slots per iteration, optionally
+  compiled as a sharded program over a ``("data", "seq")`` mesh
+  (``models/sharded_generate.build_serving_ops``).
+- :mod:`~elephas_tpu.serving.metrics` — per-request TTFT / queue-wait /
+  decode throughput and engine gauges (active slots, queue depth, batch
+  occupancy) as a JSON snapshot.
+
+Greedy outputs are token-identical to per-request
+``TransformerLM.generate`` (``tests/serving/test_engine.py`` pins it under
+interleaved mixed-length submission), so the serving layer adds
+THROUGHPUT, never drift.
+"""
+
+from .cache import SlotKVCache
+from .engine import FinishedRequest, ServingEngine
+from .metrics import ServingMetrics
+from .scheduler import AdmissionError, Scheduler, ServingRequest
+
+__all__ = [
+    "AdmissionError",
+    "FinishedRequest",
+    "Scheduler",
+    "ServingEngine",
+    "ServingMetrics",
+    "ServingRequest",
+    "SlotKVCache",
+]

@@ -1,0 +1,155 @@
+"""Slot-based batched KV cache: the device state of the serving engine.
+
+One fixed ``{"k"/"v": [L, S, Hkv, T, Dh]}`` buffer pair (the standard
+:meth:`TransformerLM.init_cache` layout with batch = ``n_slots``) backs
+every in-flight request: the BATCH axis is the SLOT axis. A request's
+lifecycle against it:
+
+1. **allocate** — pop a slot id off the free list (host bookkeeping only).
+2. **prefill-insert** — run the prompt through
+   :meth:`TransformerLM.prefill_slot` (a ``decode_chunk`` at position 0
+   over just that slot's rows), which writes the prompt's K/V without
+   touching any other slot. Prompts are right-padded to a power-of-two
+   bucket so the insert program compiles once per bucket, not once per
+   prompt length; pad K/V is harmless by the staleness-repair invariant
+   (every pad position is overwritten by this request's own decode writes
+   before any of its queries attend it) and the first token is read from
+   the REAL last row of the logits.
+3. **decode in place** — the engine's batched ``decode_step`` advances all
+   active slots with per-row positions; this module only tracks where each
+   slot's write head is.
+4. **release** — push the slot id back on the free list. No device work:
+   the stale K/V left behind is dead by construction (the next occupant's
+   prefill starts at position 0 and repairs every position before reading
+   it), which is what makes slot reclaim O(1).
+
+Rolling (all-windowed) caches are refused up front — their ring-write
+margin bookkeeping is per-rollout, not per-slot (see
+:meth:`TransformerLM.prefill_slot`).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bucket_length(n: int, minimum: int = 8) -> int:
+    """Smallest power of two >= ``n`` (and >= ``minimum``): the prompt pad
+    target, so one compiled insert program serves a 2× range of prompt
+    lengths instead of one program per length."""
+    b = max(int(minimum), 1)
+    while b < n:
+        b *= 2
+    return b
+
+
+@partial(jax.jit, static_argnames=("model",))
+def _insert_kernel(model, params, cache, tokens, t_last, slot):
+    """Compiled prefill-insert: ``tokens`` ``[1, Tb]`` (bucket-padded) into
+    slot ``slot`` of ``cache``; returns (last real logits ``[V]`` f32,
+    cache). Keyed on (model, Tb) — ``t_last``/``slot`` stay traced so every
+    request in a bucket reuses one program."""
+    logits, cache = model.prefill_slot(params, tokens, slot, cache)
+    last = jax.lax.dynamic_index_in_dim(logits[0], t_last, axis=0,
+                                        keepdims=False)
+    return last, cache
+
+
+class SlotKVCache:
+    """Free-list + per-slot write-head bookkeeping over one batched KV
+    buffer. Pure host object apart from the buffers it owns: every device
+    mutation goes through the compiled insert kernel or the engine's
+    decode step, and ``self.cache`` is always the current functional value.
+
+    ``capacity`` overrides the cache time axis (already-aligned totals
+    only — the sharded engine passes ``shards × aligned(ceil(len/shards))``
+    so each shard's local slice meets the flash-decode block contract);
+    default is ``aligned_cache_length(max_len)`` via ``init_cache``.
+    """
+
+    def __init__(self, model, params, n_slots: int,
+                 max_len: Optional[int] = None,
+                 capacity: Optional[int] = None,
+                 cache: Optional[Dict[str, Any]] = None):
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        if model._ring_cache:
+            raise NotImplementedError(
+                "SlotKVCache needs a linear (horizon) cache; all-windowed "
+                "models allocate rolling buffers (see "
+                "TransformerLM.prefill_slot)"
+            )
+        self.model = model
+        self.params = params
+        self.n_slots = int(n_slots)
+        self.max_len = int(model.max_len if max_len is None else max_len)
+        if cache is not None:
+            self.cache = cache          # sharded engine pre-places its own
+        else:
+            self.cache = model.init_cache(self.n_slots,
+                                          length=capacity or self.max_len)
+        self.capacity = int(self.cache["k"].shape[3])
+        if self.max_len > self.capacity:
+            raise ValueError(
+                f"max_len {self.max_len} exceeds cache capacity "
+                f"{self.capacity}")
+        self._free: List[int] = list(range(self.n_slots - 1, -1, -1))
+        # write head per slot: the absolute position the NEXT write lands
+        # at (prompt length after insert; +1 per decode step)
+        self.pos = np.zeros(self.n_slots, np.int32)
+
+    # -- slot accounting -------------------------------------------------
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_slots(self) -> int:
+        return self.n_slots - len(self._free)
+
+    def allocate(self) -> int:
+        if not self._free:
+            raise RuntimeError("no free slot (caller must check free_slots)")
+        return self._free.pop()
+
+    def release(self, slot: int) -> None:
+        if slot in self._free or not 0 <= slot < self.n_slots:
+            raise ValueError(f"bad release of slot {slot}")
+        self.pos[slot] = 0
+        self._free.append(slot)
+
+    # -- device ops ------------------------------------------------------
+    def insert(self, slot: int, prompt: np.ndarray,
+               insert_fn=None) -> jnp.ndarray:
+        """Prefill ``prompt`` ``[T0]`` int into ``slot``; returns the
+        logits of the last REAL prompt position ``[V]`` (what the first
+        generated token is selected from). ``insert_fn`` overrides the
+        compiled kernel (the sharded engine passes its shard_map'd one
+        with the same ``(params, cache, tokens, t_last, slot) →
+        (last, cache)`` signature)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        T0 = prompt.shape[0]
+        if not 1 <= T0 <= self.max_len:
+            raise ValueError(f"prompt length {T0} not in [1, {self.max_len}]")
+        Tb = min(bucket_length(T0), self.capacity)
+        padded = np.zeros((1, Tb), np.int32)
+        padded[0, :T0] = prompt
+        fn = insert_fn if insert_fn is not None else partial(
+            _insert_kernel, self.model)
+        last, self.cache = fn(self.params, self.cache, jnp.asarray(padded),
+                              T0 - 1, slot)
+        self.pos[slot] = T0
+        return last
+
+    def advance(self, slot: int) -> None:
+        """Record one decode-step write for ``slot`` (the write itself
+        happened inside the engine's batched decode program)."""
+        self.pos[slot] += 1
+
+    def remaining(self, slot: int) -> int:
+        return self.max_len - int(self.pos[slot])

@@ -1,0 +1,266 @@
+"""The continuous-batching driver loop.
+
+``ServingEngine`` is the host orchestrator over two compiled programs —
+one prefill-insert (per prompt-length bucket) and ONE batched decode step
+— multiplexing every in-flight request through them:
+
+    submit() ──▶ scheduler (bounded queue) ──▶ prefill into a free slot
+                                                     │ first token
+                                                     ▼
+                     one decode_step over ALL slots per step()
+                     (per-row positions; free slots ride along
+                      as pos-0 no-ops whose output is ignored)
+                                                     │ token per slot
+                                                     ▼
+                     EOS / length? → release slot → next queued request
+
+The decode batch is always the full ``[n_slots]`` geometry, so the decode
+program compiles ONCE: admission, completion, and reclaim never retrace.
+Free slots decode a dummy token at position 0 — the garbage K/V that
+writes is dead by the staleness-repair invariant (the next occupant's
+prefill overwrites it before anything attends it), and position 0 is the
+cheapest row a masked decode can run.
+
+Selection is per slot inside the compiled step
+(:func:`~elephas_tpu.models.transformer.select_slot_tokens`): greedy rows
+and sampled rows coexist in one batch, and a request's sample stream is
+keyed by ``(seed, position)`` — independent of slot assignment and of
+what else is co-batched, so results are reproducible under any
+interleaving. Greedy outputs are token-identical to per-request
+:meth:`TransformerLM.generate`.
+
+With ``mesh=`` the two programs come from
+:func:`~elephas_tpu.models.sharded_generate.build_serving_ops` instead:
+slots shard over ``"data"``, the KV cache time axis over ``"seq"``, and
+the driver loop here is UNCHANGED — the ops have the same signatures.
+
+Time is injectable (``clock=``): latency tests pin exact TTFT/queue-wait
+numbers with a fake clock instead of sleeping.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.transformer import select_slot_tokens
+from .cache import SlotKVCache
+from .metrics import RequestTiming, ServingMetrics
+from .scheduler import AdmissionError, Scheduler, ServingRequest
+
+
+@partial(jax.jit, static_argnames=("model",))
+def _decode_kernel(model, params, cache, tokens, pos, temps, keys):
+    """One batched decode step over every slot + per-slot selection, as a
+    single program: ``tokens``/``pos``/``temps`` ``[S]``, ``keys``
+    ``[S, 2]`` → ``(next tokens [S] int32, cache)``. ``pos`` is per-row —
+    exactly the batched-speculative form of ``decode_step`` — so slots at
+    wildly different depths advance together."""
+    logits, cache = model.decode_step(params, tokens, pos, cache)
+    return select_slot_tokens(logits, pos + 1, temps, keys), cache
+
+
+@jax.jit
+def _select_first(last, t0, temp, key):
+    """Select the FIRST generated token from the prefill's last-position
+    logits ``[V]`` with the same per-slot rule the decode step applies
+    (the token occupies position ``t0``)."""
+    return select_slot_tokens(
+        last[None], jnp.asarray([t0]), jnp.asarray([temp]), key[None])[0]
+
+
+@dataclass
+class FinishedRequest:
+    """Terminal record handed back by :meth:`ServingEngine.result` /
+    :meth:`ServingEngine.drain`."""
+
+    request_id: str
+    prompt: np.ndarray            # [T0] int32
+    tokens: List[int]             # generated continuation (EOS included)
+    finish_reason: str            # "eos" | "length"
+    timing: RequestTiming
+
+
+class ServingEngine:
+    """Continuous-batching inference over one model: ``submit() →
+    request_id``, ``step()`` (one scheduler action), ``drain()`` (run to
+    empty). See the module docstring for the loop shape."""
+
+    def __init__(self, model, params, n_slots: int = 8,
+                 max_len: Optional[int] = None, max_queue: int = 64,
+                 mesh=None, clock: Callable[[], float] = time.monotonic,
+                 metrics_window: int = 1024):
+        self.model = model
+        self.params = params
+        self.clock = clock
+        self.scheduler = Scheduler(max_queue=max_queue)
+        self.metrics = ServingMetrics(n_slots=n_slots, window=metrics_window)
+        if mesh is None:
+            self.kv = SlotKVCache(model, params, n_slots, max_len=max_len)
+            self._insert_fn = None          # SlotKVCache's compiled default
+            self._decode_fn = partial(_decode_kernel, model)
+        else:
+            # deferred import: sharded_generate is a heavier module and
+            # this is the only place the local path would pull it in
+            from ..models.sharded_generate import build_serving_ops
+            ops = build_serving_ops(model, mesh, n_slots,
+                                    max_len=max_len)
+            self.kv = SlotKVCache(model, params, n_slots,
+                                  max_len=ops.max_len, cache=ops.init_cache())
+            self._insert_fn = ops.insert
+            self._decode_fn = ops.decode
+        # per-slot device-step inputs, mirrored host-side (tiny [S] arrays;
+        # the per-step host→device copies are noise next to the forward)
+        S = self.kv.n_slots
+        self._tok = np.zeros(S, np.int32)       # carry token per slot
+        self._temps = np.zeros(S, np.float32)   # <=0 ⇒ greedy row
+        self._keys = np.zeros((S, 2), np.uint32)
+        self._slot_req: Dict[int, ServingRequest] = {}
+        self._requests: Dict[str, ServingRequest] = {}
+        self._finished: Dict[str, FinishedRequest] = {}
+        self._next_id = 0
+
+    # -- submission ------------------------------------------------------
+    def submit(self, prompt, max_new: int, temperature: float = 0.0,
+               eos_id: Optional[int] = None, priority: int = 0,
+               seed: int = 0, on_token: Optional[Callable] = None,
+               request_id: Optional[str] = None) -> str:
+        """Enqueue one generation request; returns its id. Raises
+        :class:`AdmissionError` (with a machine-readable ``.reason``) on
+        validation failure or queue backpressure — rejected work never
+        holds a queue entry or a slot."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        T0 = prompt.shape[0]
+        rid = request_id or f"req-{self._next_id}"
+        try:
+            if rid in self._requests or rid in self._finished:
+                raise AdmissionError("bad_request",
+                                     f"duplicate request_id {rid!r}")
+            if max_new < 1:
+                raise AdmissionError("bad_request",
+                                     f"max_new must be >= 1, got {max_new}")
+            if T0 < 1 or T0 > self.kv.max_len:
+                raise AdmissionError(
+                    "prompt_too_long",
+                    f"prompt length {T0} not in [1, {self.kv.max_len}]")
+            if T0 + int(max_new) > self.kv.max_len:
+                raise AdmissionError(
+                    "length_exceeds_cache",
+                    f"prompt {T0} + max_new {max_new} exceeds "
+                    f"max_len {self.kv.max_len}")
+            req = ServingRequest(
+                request_id=rid, prompt=prompt, max_new=int(max_new),
+                temperature=float(temperature), eos_id=eos_id,
+                priority=int(priority), seed=int(seed), on_token=on_token,
+                timing=RequestTiming(request_id=rid, prompt_tokens=int(T0),
+                                     submitted_at=self.clock()))
+            self.scheduler.push(req)
+        except AdmissionError as e:
+            self.metrics.observe_reject(e.reason)
+            raise
+        self._next_id += 1
+        self._requests[rid] = req
+        self.metrics.observe_submit()
+        return rid
+
+    # -- the loop --------------------------------------------------------
+    def step(self) -> str:
+        """Run ONE scheduler action — ``"prefill"`` (admit the next queued
+        request into a free slot and emit its first token), ``"decode"``
+        (one batched decode step over all slots), or ``"idle"`` — and
+        return which one ran."""
+        action = self.scheduler.decide(self.kv.free_slots,
+                                       self.kv.active_slots)
+        if action == "prefill":
+            self._do_prefill(self.scheduler.pop())
+        elif action == "decode":
+            self._do_decode()
+        return action
+
+    def drain(self, max_steps: Optional[int] = None
+              ) -> Dict[str, FinishedRequest]:
+        """Step until no request is queued or active (or ``max_steps``
+        runs out); returns ALL finished requests so far by id."""
+        steps = 0
+        while self.scheduler.queue_depth or self.kv.active_slots:
+            self.step()
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return dict(self._finished)
+
+    def result(self, request_id: str) -> Optional[FinishedRequest]:
+        return self._finished.get(request_id)
+
+    def snapshot(self) -> Dict[str, object]:
+        """Engine + request metrics as one JSON-able dict."""
+        return self.metrics.snapshot(
+            active_slots=self.kv.active_slots,
+            queue_depth=self.scheduler.queue_depth)
+
+    # -- internals -------------------------------------------------------
+    def _do_prefill(self, req: ServingRequest) -> None:
+        slot = self.kv.allocate()
+        req.timing.admitted_at = self.clock()
+        last = self.kv.insert(slot, req.prompt, insert_fn=self._insert_fn)
+        self.metrics.observe_prefill()
+        T0 = int(req.prompt.shape[0])
+        key = np.asarray(jax.random.PRNGKey(req.seed), np.uint32)
+        tok = int(_select_first(last, T0, req.temperature,
+                                jnp.asarray(key)))
+        req.slot = slot
+        req.next_pos = T0           # position `tok` occupies
+        req.timing.first_token_at = self.clock()
+        self._slot_req[slot] = req
+        self._tok[slot] = tok
+        self._temps[slot] = req.temperature
+        self._keys[slot] = key
+        self._emit(req, tok)
+
+    def _do_decode(self) -> None:
+        n_active = self.kv.active_slots
+        toks, self.kv.cache = self._decode_fn(
+            self.params, self.kv.cache, jnp.asarray(self._tok),
+            jnp.asarray(self.kv.pos), jnp.asarray(self._temps),
+            jnp.asarray(self._keys))
+        self.metrics.observe_decode_step(n_active)
+        toks = np.asarray(toks)
+        for slot, req in list(self._slot_req.items()):
+            # this step WROTE each carry token's K/V at its position
+            self.kv.advance(slot)
+            req.next_pos += 1
+            self._emit(req, int(toks[slot]))
+
+    def _emit(self, req: ServingRequest, tok: int) -> None:
+        """Deliver one generated token: record, stream, finish/continue."""
+        req.generated.append(tok)
+        done_eos = req.eos_id is not None and tok == req.eos_id
+        done_len = len(req.generated) >= req.max_new
+        done = done_eos or done_len
+        if req.on_token is not None:
+            req.on_token(req.request_id, tok, done)
+        if not done:
+            self._tok[req.slot] = tok
+            return
+        req.timing.finished_at = self.clock()
+        req.timing.generated_tokens = len(req.generated)
+        req.timing.finish_reason = "eos" if done_eos else "length"
+        self.metrics.observe_finish(req.timing)
+        self._finished[req.request_id] = FinishedRequest(
+            request_id=req.request_id, prompt=req.prompt,
+            tokens=list(req.generated),
+            finish_reason=req.timing.finish_reason, timing=req.timing)
+        slot = req.slot
+        self._slot_req.pop(slot, None)
+        self._requests.pop(req.request_id, None)
+        self.kv.release(slot)
+        # park the slot as a pos-0 greedy no-op row until reassigned
+        self._tok[slot] = 0
+        self._temps[slot] = 0.0
+        self._keys[slot] = 0

@@ -1,0 +1,159 @@
+"""Serving metrics: per-request latency accounting + engine gauges.
+
+Every request carries one :class:`RequestTiming` through its lifecycle
+(submitted → admitted/prefilled → first token → finished); the engine
+stamps it with a caller-injectable ``clock`` so tests pin exact numbers
+with a fake clock instead of sleeping. :class:`ServingMetrics` aggregates
+finished timings into the quantities a capacity dashboard actually wants —
+TTFT, queue wait, decode tokens/sec (p50/p95 over a bounded window of
+completed requests) — plus engine-level gauges: active slots, queue depth,
+and batch occupancy (mean fraction of decode-batch rows doing real work;
+THE continuous-batching health number — a low value means the slot budget
+is burning FLOPs on padding rows).
+
+``snapshot()`` returns one plain-JSON-able dict (``json.dumps`` must
+succeed on it — pinned in tests); nothing here imports jax.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter, deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional
+
+
+@dataclass
+class RequestTiming:
+    """Lifecycle stamps for one request (``clock`` units, typically
+    seconds). ``None`` until the stage happens."""
+
+    request_id: str
+    prompt_tokens: int
+    submitted_at: float
+    admitted_at: Optional[float] = None      # prefill-insert started
+    first_token_at: Optional[float] = None   # first generated token emitted
+    finished_at: Optional[float] = None
+    generated_tokens: int = 0
+    finish_reason: Optional[str] = None      # "eos" | "length"
+
+    @property
+    def queue_wait(self) -> Optional[float]:
+        if self.admitted_at is None:
+            return None
+        return self.admitted_at - self.submitted_at
+
+    @property
+    def ttft(self) -> Optional[float]:
+        """Time to first token, from SUBMIT (queue wait included — the
+        latency the caller experiences, not the latency the GPU sees)."""
+        if self.first_token_at is None:
+            return None
+        return self.first_token_at - self.submitted_at
+
+    @property
+    def decode_tokens_per_sec(self) -> Optional[float]:
+        """Generated tokens over the admitted→finished span."""
+        if self.finished_at is None or self.admitted_at is None:
+            return None
+        dt = self.finished_at - self.admitted_at
+        if dt <= 0:
+            return None
+        return self.generated_tokens / dt
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile on an already-sorted list (no numpy — the
+    snapshot must be buildable host-side with zero array deps)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+@dataclass
+class ServingMetrics:
+    """Engine-level counters/gauges + a bounded window of finished
+    request timings."""
+
+    n_slots: int
+    window: int = 1024  # finished-request timings kept for percentiles
+
+    submitted: int = 0
+    rejected: Counter = field(default_factory=Counter)  # reason → count
+    completed: int = 0
+    tokens_generated: int = 0
+    prefills: int = 0
+    decode_steps: int = 0
+    _occupancy_sum: float = 0.0  # Σ (active rows / slots) over decode steps
+    _finished: Deque[RequestTiming] = field(default_factory=deque)
+
+    def observe_reject(self, reason: str) -> None:
+        self.rejected[reason] += 1
+
+    def observe_submit(self) -> None:
+        self.submitted += 1
+
+    def observe_prefill(self) -> None:
+        self.prefills += 1
+
+    def observe_decode_step(self, n_active: int) -> None:
+        self.decode_steps += 1
+        self._occupancy_sum += n_active / self.n_slots
+
+    def observe_finish(self, timing: RequestTiming) -> None:
+        self.completed += 1
+        self.tokens_generated += timing.generated_tokens
+        self._finished.append(timing)
+        while len(self._finished) > self.window:
+            self._finished.popleft()
+
+    @property
+    def batch_occupancy(self) -> float:
+        """Mean active-rows / slots over all decode steps so far."""
+        if not self.decode_steps:
+            return 0.0
+        return self._occupancy_sum / self.decode_steps
+
+    def _dist(self, vals: List[float]) -> Dict[str, float]:
+        vals = sorted(v for v in vals if v is not None)
+        if not vals:
+            return {"count": 0, "p50": 0.0, "p95": 0.0, "mean": 0.0}
+        return {
+            "count": len(vals),
+            "p50": round(_percentile(vals, 0.50), 6),
+            "p95": round(_percentile(vals, 0.95), 6),
+            "mean": round(sum(vals) / len(vals), 6),
+        }
+
+    def snapshot(self, active_slots: int = 0,
+                 queue_depth: int = 0) -> Dict[str, object]:
+        """One JSON-able dict of everything above. The two live gauges are
+        the ENGINE's to report (the metrics object never reaches into the
+        scheduler), so they arrive as arguments."""
+        fin = list(self._finished)
+        return {
+            "engine": {
+                "n_slots": self.n_slots,
+                "active_slots": active_slots,
+                "queue_depth": queue_depth,
+                "batch_occupancy": round(self.batch_occupancy, 4),
+                "prefills": self.prefills,
+                "decode_steps": self.decode_steps,
+            },
+            "counters": {
+                "submitted": self.submitted,
+                "rejected": dict(self.rejected),
+                "completed": self.completed,
+                "tokens_generated": self.tokens_generated,
+            },
+            "requests": {
+                "ttft_s": self._dist([t.ttft for t in fin]),
+                "queue_wait_s": self._dist([t.queue_wait for t in fin]),
+                "decode_tokens_per_sec": self._dist(
+                    [t.decode_tokens_per_sec for t in fin]),
+            },
+        }
+
+    def to_json(self, **gauges) -> str:
+        return json.dumps(self.snapshot(**gauges))

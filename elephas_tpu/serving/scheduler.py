@@ -1,0 +1,101 @@
+"""Admission control + the prefill-vs-decode decision.
+
+The scheduler is pure host-side bookkeeping (no jax): a BOUNDED
+FIFO+priority queue in front of the slot budget. Boundedness is the
+backpressure mechanism — a full queue REJECTS at submit time with a
+machine-readable reason instead of buffering unboundedly and timing every
+caller out later (the fail-fast discipline a loaded service needs;
+callers retry against another replica). Within the queue, higher
+``priority`` runs first and FIFO breaks ties, so equal-priority traffic
+keeps arrival order (no starvation among peers; a persistent stream of
+high-priority work CAN starve low priority — that is the knob's contract,
+documented, not accidental).
+
+The per-iteration policy (:meth:`Scheduler.decide`) is prefill-first:
+admit waiting work into free slots before running the batched decode
+step. Prefill-first maximizes batch occupancy (a freshly admitted row
+joins every subsequent decode step) and minimizes TTFT; the decode batch
+it momentarily delays loses one step of latency, which continuous
+batching amortizes across the whole rollout.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Callable, List, Optional, Tuple
+
+from .metrics import RequestTiming
+
+
+class AdmissionError(Exception):
+    """A submit was rejected; ``reason`` is machine-readable
+    (``"queue_full"``, ``"prompt_too_long"``, ``"length_exceeds_cache"``,
+    ``"bad_request"``)."""
+
+    def __init__(self, reason: str, detail: str = ""):
+        self.reason = reason
+        super().__init__(f"{reason}: {detail}" if detail else reason)
+
+
+@dataclass
+class ServingRequest:
+    """One in-flight generation request (host-side state; the device state
+    is its slot's rows of the :class:`~elephas_tpu.serving.cache.SlotKVCache`)."""
+
+    request_id: str
+    prompt: Any                    # np.int32 [T0]
+    max_new: int
+    temperature: float = 0.0
+    eos_id: Optional[int] = None
+    priority: int = 0
+    seed: int = 0
+    on_token: Optional[Callable] = None  # (request_id, token, done) -> None
+    timing: Optional[RequestTiming] = None
+    # engine-managed decode state
+    slot: Optional[int] = None
+    carry: Optional[int] = None    # last emitted token, not yet in cache
+    next_pos: int = 0              # absolute position `carry` will occupy
+    generated: List[int] = field(default_factory=list)
+
+
+class Scheduler:
+    """Bounded FIFO+priority queue + the per-iteration action policy."""
+
+    def __init__(self, max_queue: int = 64):
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        self.max_queue = int(max_queue)
+        self._heap: List[Tuple[int, int, ServingRequest]] = []
+        self._seq = itertools.count()  # FIFO tiebreak within a priority
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._heap)
+
+    def push(self, req: ServingRequest) -> None:
+        """Enqueue or reject-with-reason (the backpressure point)."""
+        if len(self._heap) >= self.max_queue:
+            raise AdmissionError(
+                "queue_full",
+                f"{len(self._heap)} waiting >= max_queue {self.max_queue}")
+        # negated priority: heapq is a min-heap, higher priority runs first
+        heapq.heappush(self._heap, (-int(req.priority), next(self._seq), req))
+
+    def pop(self) -> Optional[ServingRequest]:
+        if not self._heap:
+            return None
+        return heapq.heappop(self._heap)[2]
+
+    def decide(self, free_slots: int, active_slots: int) -> str:
+        """The next engine action: ``"prefill"`` (waiting work + a free
+        slot), else ``"decode"`` (any active slot), else ``"idle"``."""
+        if self._heap and free_slots > 0:
+            return "prefill"
+        if active_slots > 0:
+            return "decode"
+        return "idle"

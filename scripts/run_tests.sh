@@ -26,7 +26,9 @@ WATCHDOG_FILE="${ELEPHAS_WATCHDOG_FILE:-$(mktemp /tmp/elephas_watchdog.XXXXXX)}"
 export ELEPHAS_WATCHDOG_FILE="$WATCHDOG_FILE"
 
 # Top-level shards: every directory under tests/ plus tests/ itself
-# non-recursively (pytest.ini-style rootdir files).
+# non-recursively (pytest.ini-style rootdir files). New test trees are
+# picked up automatically — tests/serving/ (the continuous-batching
+# engine) runs as its own shard like models/ops/parallel.
 shards=()
 for d in tests/*/; do
   [ -d "$d" ] && [ -n "$(find "$d" -name 'test_*.py' -print -quit)" ] \

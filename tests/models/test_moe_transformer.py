@@ -10,6 +10,8 @@ import optax
 import pytest
 
 import jax
+
+from elephas_tpu.compat import shard_map as compat_shard_map
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -58,7 +60,7 @@ def test_forward_matches_dense_oracle(dp, sp):
         return logits, aux[None]
 
     fwd = jax.jit(
-        jax.shard_map(
+        compat_shard_map(
             impl, mesh=mesh,
             in_specs=(model.specs(), P("data", "seq"), P("data", "seq")),
             out_specs=(P("data", "seq"), P("data")),

@@ -7,6 +7,8 @@ agrees" and every correction IS the target argmax. A bad draft only costs
 speed, never output.
 """
 
+import itertools
+
 import numpy as np
 import pytest
 
@@ -450,3 +452,88 @@ def test_moe_capacity_pin_is_exactly_the_boundary():
     assert MoETransformerLM(capacity_factor=4.0, **kw)._supports_speculative
     assert not MoETransformerLM(capacity_factor=3.9,
                                 **kw)._supports_speculative
+
+
+def _all_hists(V, max_len):
+    """Every token history of length 0..max_len over a V-token vocab."""
+    out = [()]
+    for j in range(1, max_len + 1):
+        out.extend(itertools.product(range(V), repeat=j))
+    return out
+
+
+def test_sampled_rejection_rule_exact_distribution():
+    """CLOSED-FORM exactness of the sampled rejection rule, model-free.
+
+    ``spec_round_accept`` is the acceptance math the compiled rollout
+    runs. On a 4-token vocab with spec_k=2 this test enumerates every
+    draft proposal combo, marginalizes the acceptance uniforms
+    analytically (accept prob ``a_i = min(1, p_t(d_i)/p_d(d_i))`` in
+    f64), reads each stop-slot's residual distribution FROM the function
+    (forcing each acceptance pattern with constructed uniforms —
+    ``u = a/2`` accepts, ``u = (1+a)/2`` rejects), and assembles the
+    exact joint distribution over the round's emitted token sequences.
+
+    The speculative guarantee is then checked per POSITION: conditioned
+    on any emitted prefix and on the round reaching position ``j``, the
+    j-th emitted token is distributed exactly as the target's conditional
+    ``T(. | prefix)``. Position ``k+1`` (a fully-accepted round) isolates
+    the bonus-slot zero-padding of ``p_d`` (its residual must be ``p_t``
+    itself); every rejection branch isolates the clamped normalized
+    residual ``(p_t − p_d)+``. Perturbing either — dropping the clamp,
+    padding with anything but zeros, reading the wrong stop slot — shifts
+    a conditional by O(1), far beyond the 5e-5 f32 tolerance; the TV test
+    above stays as an end-to-end smoke over the full rollout.
+    """
+    from collections import defaultdict
+
+    from elephas_tpu.models.transformer import spec_round_accept
+
+    V, K = 4, 2
+    rng = np.random.default_rng(0)
+
+    def _dist():
+        p = rng.uniform(0.05, 1.0, V)
+        return p / p.sum()
+
+    T = {h: _dist() for h in _all_hists(V, K)}       # target conditionals
+    D = {h: _dist() for h in _all_hists(V, K - 1)}   # draft conditionals
+
+    joint = defaultdict(float)
+    for d in itertools.product(range(V), repeat=K):
+        q = np.prod([D[d[:i]][d[i]] for i in range(K)])
+        pt = np.stack([T[d[:i]] for i in range(K + 1)])   # [K+1, V]
+        pd = np.stack([D[d[:i]] for i in range(K)])       # [K, V]
+        a = np.minimum(1.0, pt[np.arange(K), list(d)]
+                       / pd[np.arange(K), list(d)])       # accept probs, f64
+        for n in range(K + 1):
+            stop = 1.0 - a[n] if n < K else 1.0
+            p_n = np.prod(a[:n]) * stop
+            if p_n <= 0.0:
+                continue
+            u = np.array([a[i] / 2 if i < n else (1 + a[i]) / 2
+                          for i in range(K)], np.float32)
+            n_dev, resid = spec_round_accept(
+                jnp.asarray(pt, jnp.float32)[None],
+                jnp.asarray(pd, jnp.float32)[None],
+                jnp.asarray(np.array(d), jnp.int32)[None],
+                jnp.asarray(u)[None])
+            assert int(n_dev[0]) == n        # the forced pattern held
+            resid = np.asarray(resid[0], np.float64)
+            for c in range(V):
+                joint[d[:n] + (c,)] += q * p_n * resid[c]
+
+    assert abs(sum(joint.values()) - 1.0) < 1e-5
+
+    for j in range(1, K + 2):
+        for h in itertools.product(range(V), repeat=j - 1):
+            emitted = np.zeros(V)
+            for seq, p in joint.items():
+                if len(seq) >= j and seq[:j - 1] == h:
+                    emitted[seq[j - 1]] += p
+            reach = emitted.sum()            # P(round reaches position j
+            if reach < 1e-12:                #   along this prefix)
+                continue
+            np.testing.assert_allclose(
+                emitted / reach, T[h], atol=5e-5,
+                err_msg=f"conditional at position {j} after prefix {h}")

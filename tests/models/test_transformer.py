@@ -10,6 +10,8 @@ import optax
 import pytest
 
 import jax
+
+from elephas_tpu.compat import shard_map as compat_shard_map
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -47,7 +49,7 @@ def test_forward_matches_dense(attn, dp, sp):
 
     mesh = build_mesh_sp(data=dp, seq=sp)
     fwd = jax.jit(
-        jax.shard_map(
+        compat_shard_map(
             lambda p, tk, ps: model.apply(p, tk, ps, attn=attn),
             mesh=mesh,
             in_specs=(model.specs(), P("data", "seq"), P("data", "seq")),
@@ -178,7 +180,7 @@ def test_rotary_forward_matches_dense_and_learns():
     want = np.asarray(model.apply(params, tokens, positions, attn="dense"))
     mesh = build_mesh_sp(data=2, seq=4)
     fwd = jax.jit(
-        jax.shard_map(
+        compat_shard_map(
             lambda p, tk, ps: model.apply(p, tk, ps, attn="ring"),
             mesh=mesh,
             in_specs=(model.specs(), P("data", "seq"), P("data", "seq")),
@@ -269,7 +271,7 @@ def test_gqa_matches_dense_and_shrinks_cache(n_kv, pos_enc):
     want = np.asarray(model.apply(params, tokens, positions, attn="dense"))
     mesh = build_mesh_sp(data=2, seq=4)
     fwd = jax.jit(
-        jax.shard_map(
+        compat_shard_map(
             lambda p, tk, ps: model.apply(p, tk, ps, attn="ring"),
             mesh=mesh,
             in_specs=(model.specs(), P("data", "seq"), P("data", "seq")),

@@ -10,6 +10,8 @@ import optax
 import pytest
 
 import jax
+
+from elephas_tpu.compat import shard_map as compat_shard_map
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -32,7 +34,7 @@ def test_forward_matches_dense(dp, pp, tp):
     want = np.asarray(model.apply_reference(params, x))
 
     fwd = jax.jit(
-        jax.shard_map(
+        compat_shard_map(
             lambda p, xb: model.apply(p, xb, n_micro=4),
             mesh=mesh, in_specs=(model.specs(), P("data")),
             out_specs=P("data"), check_vma=False,

@@ -10,6 +10,8 @@ import optax
 import pytest
 
 import jax
+
+from elephas_tpu.compat import shard_map as compat_shard_map
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -53,7 +55,7 @@ def test_forward_matches_oracle(dp, ep, k):
         return yb, aux[None]  # aux replicated within each expert group
 
     fwd = jax.jit(
-        jax.shard_map(
+        compat_shard_map(
             impl, mesh=mesh,
             in_specs=(model.specs(), token_spec),
             out_specs=(token_spec, P("data")),
@@ -146,7 +148,7 @@ def test_expert_choice_forward_matches_oracle(dp, ep):
     sharded = model.shard_params(mesh, params)
     token_spec = P(("data", "expert"))
     fwd = jax.jit(
-        jax.shard_map(
+        compat_shard_map(
             lambda p, xb: model.apply(p, xb)[0], mesh=mesh,
             in_specs=(model.specs(), token_spec), out_specs=token_spec,
             check_vma=False,

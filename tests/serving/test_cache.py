@@ -1,0 +1,114 @@
+"""SlotKVCache: insert correctness against the batched prefill oracle,
+slot isolation, free-list accounting, bucketed compile reuse."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from elephas_tpu.models.transformer import TransformerLM
+from elephas_tpu.serving.cache import SlotKVCache, bucket_length
+
+pytestmark = pytest.mark.serving
+
+V = 17
+
+
+def _model(**kw):
+    cfg = dict(vocab=V, d_model=16, n_heads=4, n_layers=2, d_ff=32,
+               max_len=48)
+    cfg.update(kw)
+    return TransformerLM(**cfg)
+
+
+def _params(model, seed=1):
+    return {k: jnp.asarray(v) for k, v in model.init(seed=seed).items()}
+
+
+def test_bucket_length():
+    assert bucket_length(1) == 8            # floor
+    assert bucket_length(8) == 8
+    assert bucket_length(9) == 16
+    assert bucket_length(13) == 16
+    assert bucket_length(33) == 64
+
+
+def test_free_list_accounting():
+    model = _model()
+    kv = SlotKVCache(model, _params(model), n_slots=3)
+    assert (kv.free_slots, kv.active_slots) == (3, 0)
+    a = kv.allocate()
+    b = kv.allocate()
+    assert a != b and kv.free_slots == 1
+    kv.release(a)
+    assert kv.free_slots == 2
+    with pytest.raises(ValueError):
+        kv.release(a)                       # double release
+    kv.allocate()
+    kv.allocate()
+    with pytest.raises(RuntimeError):
+        kv.allocate()                       # exhausted
+
+
+@pytest.mark.parametrize("kw", [{}, {"pos_encoding": "rotary",
+                                     "n_kv_heads": 2}])
+def test_insert_matches_prefill_logits(kw):
+    """The bucket-padded slot insert must reproduce the batched prefill's
+    last-real-position logits (pad rows are garbage by contract — only the
+    returned row is meaningful)."""
+    model = _model(**kw)
+    params = _params(model)
+    rng = np.random.default_rng(0)
+    kv = SlotKVCache(model, params, n_slots=2)
+    for slot, T0 in ((kv.allocate(), 5), (kv.allocate(), 11)):
+        prompt = rng.integers(0, V, size=(T0,)).astype(np.int32)
+        last = np.asarray(kv.insert(slot, prompt))
+        cache = model.init_cache(1, length=kv.capacity)
+        ref, _ = model.prefill(params, jnp.asarray(prompt)[None], cache)
+        np.testing.assert_allclose(last, np.asarray(ref)[0, -1],
+                                   atol=2e-4, rtol=2e-4)
+        assert kv.pos[slot] == T0
+
+
+def test_insert_leaves_other_slots_untouched():
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(1)
+    kv = SlotKVCache(model, params, n_slots=3)
+    s0 = kv.allocate()
+    kv.insert(s0, rng.integers(0, V, size=(7,)).astype(np.int32))
+    before = np.asarray(kv.cache["k"])[:, s0].copy()
+    s1 = kv.allocate()
+    kv.insert(s1, rng.integers(0, V, size=(4,)).astype(np.int32))
+    after = np.asarray(kv.cache["k"])[:, s0]
+    np.testing.assert_array_equal(before, after)
+
+
+def test_insert_reuses_one_program_per_bucket():
+    """Prompts of length 5 and 7 share the 8-bucket: the compiled insert
+    must not retrace (same program, different t_last)."""
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(2)
+    kv = SlotKVCache(model, params, n_slots=2)
+    from elephas_tpu.serving.cache import _insert_kernel
+    before = _insert_kernel._cache_size()
+    kv.insert(kv.allocate(), rng.integers(0, V, size=(5,)).astype(np.int32))
+    kv.insert(kv.allocate(), rng.integers(0, V, size=(7,)).astype(np.int32))
+    assert _insert_kernel._cache_size() - before == 1
+
+
+def test_prompt_length_validation():
+    model = _model()
+    kv = SlotKVCache(model, _params(model), n_slots=1)
+    slot = kv.allocate()
+    with pytest.raises(ValueError):
+        kv.insert(slot, np.zeros(0, np.int32))
+    with pytest.raises(ValueError):
+        kv.insert(slot, np.zeros(model.max_len + 1, np.int32))
+
+
+def test_ring_cache_refused():
+    model = _model(attn_window=8)           # all-windowed → rolling buffer
+    with pytest.raises(NotImplementedError):
+        SlotKVCache(model, _params(model), n_slots=2)

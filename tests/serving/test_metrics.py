@@ -1,0 +1,84 @@
+"""ServingMetrics: pinned numbers under a fake clock, JSON-able snapshot."""
+
+import json
+
+import pytest
+
+from elephas_tpu.serving.metrics import (
+    RequestTiming,
+    ServingMetrics,
+    _percentile,
+)
+
+pytestmark = pytest.mark.serving
+
+
+def _timing(rid="r", prompt=4, sub=0.0, adm=1.0, first=2.0, fin=6.0, gen=8,
+            reason="length"):
+    return RequestTiming(request_id=rid, prompt_tokens=prompt,
+                         submitted_at=sub, admitted_at=adm,
+                         first_token_at=first, finished_at=fin,
+                         generated_tokens=gen, finish_reason=reason)
+
+
+def test_request_timing_derived_quantities():
+    t = _timing()
+    assert t.queue_wait == 1.0
+    assert t.ttft == 2.0                  # from SUBMIT, queue wait included
+    assert t.decode_tokens_per_sec == 8 / 5.0   # admitted → finished
+
+    # unfinished stages stay None instead of crashing
+    partial = RequestTiming(request_id="p", prompt_tokens=1, submitted_at=0.0)
+    assert partial.queue_wait is None
+    assert partial.ttft is None
+    assert partial.decode_tokens_per_sec is None
+
+
+def test_percentile_nearest_rank():
+    vals = [1.0, 2.0, 3.0, 4.0, 5.0]
+    assert _percentile(vals, 0.50) == 3.0
+    assert _percentile(vals, 0.0) == 1.0
+    assert _percentile(vals, 1.0) == 5.0
+    assert _percentile([], 0.5) == 0.0
+
+
+def test_batch_occupancy_is_mean_active_fraction():
+    m = ServingMetrics(n_slots=4)
+    assert m.batch_occupancy == 0.0
+    m.observe_decode_step(4)
+    m.observe_decode_step(2)
+    assert m.batch_occupancy == pytest.approx((1.0 + 0.5) / 2)
+
+
+def test_snapshot_is_json_able_and_complete():
+    m = ServingMetrics(n_slots=2)
+    m.observe_submit()
+    m.observe_submit()
+    m.observe_reject("queue_full")
+    m.observe_prefill()
+    m.observe_decode_step(2)
+    m.observe_finish(_timing(rid="a", fin=5.0, gen=4))
+    m.observe_finish(_timing(rid="b", sub=1.0, adm=1.5, first=3.5, fin=9.5,
+                             gen=16))
+    snap = m.snapshot(active_slots=1, queue_depth=3)
+    roundtrip = json.loads(json.dumps(snap))    # must survive json
+
+    eng = roundtrip["engine"]
+    assert eng == {"n_slots": 2, "active_slots": 1, "queue_depth": 3,
+                   "batch_occupancy": 1.0, "prefills": 1, "decode_steps": 1}
+    ctr = roundtrip["counters"]
+    assert ctr["submitted"] == 2
+    assert ctr["rejected"] == {"queue_full": 1}
+    assert ctr["completed"] == 2
+    assert ctr["tokens_generated"] == 20
+    ttft = roundtrip["requests"]["ttft_s"]
+    assert ttft["count"] == 2
+    assert ttft["p50"] == 2.0 and ttft["p95"] == 2.5
+
+
+def test_finished_window_is_bounded():
+    m = ServingMetrics(n_slots=1, window=3)
+    for i in range(10):
+        m.observe_finish(_timing(rid=f"r{i}", gen=1))
+    assert m.completed == 10                   # counter keeps the total
+    assert m.snapshot()["requests"]["ttft_s"]["count"] == 3

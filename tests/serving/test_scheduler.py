@@ -1,0 +1,68 @@
+"""Scheduler: priority-then-FIFO order, bounded-queue backpressure, the
+prefill-vs-decode decision."""
+
+import numpy as np
+import pytest
+
+from elephas_tpu.serving.scheduler import (
+    AdmissionError,
+    Scheduler,
+    ServingRequest,
+)
+
+pytestmark = pytest.mark.serving
+
+
+def _req(rid, priority=0):
+    return ServingRequest(request_id=rid, prompt=np.zeros(2, np.int32),
+                          max_new=4, priority=priority)
+
+
+def test_fifo_within_priority():
+    s = Scheduler(max_queue=8)
+    for rid in ["a", "b", "c"]:
+        s.push(_req(rid))
+    assert [s.pop().request_id for _ in range(3)] == ["a", "b", "c"]
+    assert s.pop() is None
+
+
+def test_priority_wins_fifo_breaks_ties():
+    s = Scheduler(max_queue=8)
+    s.push(_req("low-1", priority=0))
+    s.push(_req("hi-1", priority=5))
+    s.push(_req("low-2", priority=0))
+    s.push(_req("hi-2", priority=5))
+    order = [s.pop().request_id for _ in range(4)]
+    assert order == ["hi-1", "hi-2", "low-1", "low-2"]
+
+
+def test_bounded_queue_rejects_with_reason():
+    s = Scheduler(max_queue=2)
+    s.push(_req("a"))
+    s.push(_req("b"))
+    with pytest.raises(AdmissionError) as ei:
+        s.push(_req("c"))
+    assert ei.value.reason == "queue_full"
+    # rejection is non-destructive: both queued requests still come out
+    assert s.queue_depth == 2
+    s.pop()
+    s.push(_req("c"))                       # capacity freed → admitted
+    assert s.queue_depth == 2
+
+
+def test_decide_is_prefill_first():
+    s = Scheduler(max_queue=4)
+    assert s.decide(free_slots=2, active_slots=0) == "idle"
+    assert s.decide(free_slots=0, active_slots=3) == "decode"
+    s.push(_req("a"))
+    # waiting work + a free slot → admit before decoding
+    assert s.decide(free_slots=1, active_slots=3) == "prefill"
+    # no free slot → the queue waits, decode proceeds
+    assert s.decide(free_slots=0, active_slots=3) == "decode"
+    s.pop()
+    assert s.decide(free_slots=1, active_slots=0) == "idle"
+
+
+def test_max_queue_validation():
+    with pytest.raises(ValueError):
+        Scheduler(max_queue=0)

@@ -23,6 +23,13 @@ def test_bench_emits_json_line():
         "BENCH_SAMPLES": "4096",
         "BENCH_EPOCHS": "1",
         "BENCH_REPS": "1",
+        # tiny serving geometry: the phase must still land in the JSON
+        "BENCH_SERVE_DMODEL": "64",
+        "BENCH_SERVE_LAYERS": "2",
+        "BENCH_SERVE_VOCAB": "128",
+        "BENCH_SERVE_SLOTS": "4",
+        "BENCH_SERVE_PROMPT": "8",
+        "BENCH_SERVE_NEW": "8",
     })
     proc = subprocess.run(
         [sys.executable, os.path.join(_REPO, "bench.py")],
@@ -35,3 +42,11 @@ def test_bench_emits_json_line():
     assert result["unit"] == "samples/sec/chip"
     assert result["value"] > 0
     assert result["vs_baseline"] > 0
+    # the serving phase is CPU-runnable, so its entry must be present
+    serving = result["serving"]
+    assert serving["agg_tokens_per_sec"] > 0
+    assert serving["sequential_tokens_per_sec"] > 0
+    assert serving["vs_sequential"] > 0
+    assert serving["ttft_p95_ms"] >= serving["ttft_p50_ms"] >= 0
+    assert 0 < serving["batch_occupancy"] <= 1
+    assert serving["concurrency"] == 4
