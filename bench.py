@@ -427,6 +427,115 @@ def bench_serving(reps: int):
     }
 
 
+def bench_recovery(reps: int):
+    """Checkpoint + auto-resume overhead vs an uninterrupted fit.
+
+    CPU-runnable. Three timed runs of the SAME host-path synchronous
+    training job: (a) plain ``SparkModel.fit``, (b) the same fit under a
+    ``TrainingSupervisor`` checkpointing every epoch, and (c) the
+    supervised fit with an injected driver crash halfway through —
+    restart, resume from the latest checkpoint, finish. Reports the
+    steady checkpointing tax (``checkpoint_overhead``) and the wall-clock
+    price of one crash+resume cycle (``recovery_penalty_s``). Skip with
+    BENCH_RECOVERY=0; size via BENCH_REC_{SAMPLES,EPOCHS,BATCH,WORKERS}.
+    """
+    import tempfile
+
+    import numpy as np
+
+    if os.environ.get("BENCH_RECOVERY", "1") == "0":
+        log("recovery bench: skipped (BENCH_RECOVERY=0)")
+        return None
+
+    from elephas_tpu import SparkModel
+    from elephas_tpu.data import SparkContext
+    from elephas_tpu.resilience import TrainingSupervisor
+    from elephas_tpu.utils import to_simple_rdd
+
+    def knob(name, default):
+        return int(os.environ.get(f"BENCH_REC_{name.upper()}", default))
+
+    n = knob("samples", 8192)
+    epochs = max(2, knob("epochs", 4))       # resume needs a second chunk
+    batch = knob("batch", 128)
+    workers = knob("workers", 2)
+    d, c = 64, 10
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, d)).astype("float32")
+    w = rng.normal(size=(d, c))
+    y = np.eye(c, dtype="float32")[(x @ w).argmax(1)]
+    sc = SparkContext(master=f"local[{workers}]", appName="bench-recovery")
+    rdd = to_simple_rdd(sc, x, y, num_slices=workers)
+    sm = SparkModel(make_model(d, c), mode="synchronous",
+                    num_workers=workers, comm="host")
+    fit_kw = dict(batch_size=batch, verbose=0, validation_split=0.0)
+    log(f"recovery bench: {n} samples x {epochs} epochs on {workers} "
+        f"host workers (warmup...)")
+    sm.fit(rdd, epochs=1, **fit_kw)          # warmup/compile
+
+    class CrashingFit:
+        """SparkModel proxy that dies once at a chosen fit-chunk call, so
+        the supervisor's restart+resume path is what gets timed."""
+
+        comm = "host"
+
+        def __init__(self, inner, crash_on_call):
+            self._inner = inner
+            self.master_network = inner.master_network
+            self.mode = inner.mode
+            self.fit_calls = 0
+            self.crash_on_call = crash_on_call
+
+        def fit(self, rdd, **kw):
+            self.fit_calls += 1
+            if self.fit_calls == self.crash_on_call:
+                raise ConnectionError("injected mid-training driver crash")
+            return self._inner.fit(rdd, **kw)
+
+    def best(label, run):
+        t = float("inf")
+        for rep in range(max(1, reps)):
+            t0 = time.perf_counter()
+            run()
+            dt = time.perf_counter() - t0
+            log(f"recovery rep {rep}: {label} {dt:.2f}s")
+            t = min(t, dt)
+        return t
+
+    t_plain = best("plain", lambda: sm.fit(rdd, epochs=epochs, **fit_kw))
+
+    def supervised(crash_on_call=None):
+        with tempfile.TemporaryDirectory() as ck:
+            model = sm if crash_on_call is None else CrashingFit(
+                sm, crash_on_call)
+            sup = TrainingSupervisor(model, ck, checkpoint_frequency=1,
+                                     max_restarts=1)
+            sup.fit(rdd, epochs=epochs, **fit_kw)
+
+    t_ckpt = best("checkpointed", supervised)
+    # crash on the chunk after the midpoint checkpoint: resume re-trains
+    # at most one epoch
+    t_resume = best("crash+resume",
+                    lambda: supervised(crash_on_call=epochs // 2 + 1))
+
+    overhead = t_ckpt / t_plain - 1.0
+    penalty = t_resume - t_ckpt
+    log(f"recovery bench: plain {t_plain:.2f}s, checkpointed {t_ckpt:.2f}s "
+        f"({overhead * 100:+.1f}%), crash+resume {t_resume:.2f}s "
+        f"(+{penalty:.2f}s for one restart)")
+    return {
+        "plain_fit_s": round(t_plain, 3),
+        "checkpointed_fit_s": round(t_ckpt, 3),
+        "checkpoint_overhead": round(overhead, 3),
+        "crash_resume_fit_s": round(t_resume, 3),
+        "recovery_penalty_s": round(penalty, 3),
+        "epochs": epochs,
+        "checkpoint_frequency": 1,
+        "config": f"{n}x{d}-e{epochs}-w{workers}",
+    }
+
+
 def make_model(input_dim, nb_classes):
     import keras
 
@@ -582,6 +691,16 @@ def main():
         serving = None
     if serving is not None:
         result["serving"] = serving
+        print(json.dumps(result), flush=True)
+
+    # -- recovery phase: checkpoint + auto-resume tax (CPU-runnable) ------
+    try:
+        recovery = bench_recovery(reps)
+    except Exception as e:
+        log(f"recovery bench failed: {type(e).__name__}: {e}")
+        recovery = None
+    if recovery is not None:
+        result["recovery"] = recovery
         print(json.dumps(result), flush=True)
 
     # -- LM phase: FLOPs-accounted tokens/sec + MFU on the same chip ------

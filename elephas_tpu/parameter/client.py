@@ -25,13 +25,15 @@ from ..utils.sockets import determine_master
 class BaseParameterClient:
     @staticmethod
     def get_client(client_mode: str = "http", port: int = 4000,
-                   host: Optional[str] = None) -> "BaseParameterClient":
+                   host: Optional[str] = None,
+                   timeout: float = 60.0) -> "BaseParameterClient":
         """Factory mirroring the reference's client selection
-        (``parameter/client.py:~15``)."""
+        (``parameter/client.py:~15``). ``timeout`` bounds every wire
+        operation (the reference hard-codes 60s at each call site)."""
         if client_mode == "http":
-            return HttpClient(port=port, host=host)
+            return HttpClient(port=port, host=host, timeout=timeout)
         if client_mode == "socket":
-            return SocketClient(port=port, host=host)
+            return SocketClient(port=port, host=host, timeout=timeout)
         raise ValueError(f"Unknown parameter server mode: {client_mode}")
 
     def get_parameters(self) -> List[np.ndarray]:
@@ -66,15 +68,17 @@ class BaseParameterClient:
 class HttpClient(BaseParameterClient):
     """Pull/push pickled weight lists over HTTP."""
 
-    def __init__(self, port: int = 4000, host: Optional[str] = None):
+    def __init__(self, port: int = 4000, host: Optional[str] = None,
+                 timeout: float = 60.0):
         if host is None:
             self.master_url = determine_master(port)
         else:
             self.master_url = f"{host}:{port}"
+        self.timeout = float(timeout)
 
     def get_parameters(self) -> List[np.ndarray]:
         with urllib.request.urlopen(
-            f"http://{self.master_url}/parameters", timeout=60
+            f"http://{self.master_url}/parameters", timeout=self.timeout
         ) as resp:
             return pickle.loads(resp.read())
 
@@ -89,7 +93,7 @@ class HttpClient(BaseParameterClient):
             headers=headers,
             method="POST",
         )
-        with urllib.request.urlopen(req, timeout=60) as resp:
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
             resp.read()
 
     def register_attempt(self, task_id: str, attempt: int) -> bool:
@@ -101,7 +105,7 @@ class HttpClient(BaseParameterClient):
             method="POST",
         )
         try:
-            with urllib.request.urlopen(req, timeout=60) as resp:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 resp.read()
             return True
         except urllib.error.HTTPError as err:
@@ -126,7 +130,7 @@ class HttpClient(BaseParameterClient):
             headers={"X-Elephas-Task": task_id},
             method="POST",
         )
-        with urllib.request.urlopen(req, timeout=60) as resp:
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
             resp.read()
 
 
@@ -137,17 +141,21 @@ class SocketClient(BaseParameterClient):
     opcode stream cannot interleave across threads sharing a client.
     """
 
-    def __init__(self, port: int = 4000, host: Optional[str] = None):
+    def __init__(self, port: int = 4000, host: Optional[str] = None,
+                 timeout: float = 60.0):
         if host is None:
             host = determine_master(port).rsplit(":", 1)[0]
         self.host = host
         self.port = int(port)
+        self.timeout = float(timeout)
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
 
     def _ensure(self) -> socket.socket:
         if self._sock is None:
-            self._sock = socket.create_connection((self.host, self.port), timeout=60)
+            self._sock = socket.create_connection(
+                (self.host, self.port), timeout=self.timeout
+            )
         return self._sock
 
     def get_parameters(self) -> List[np.ndarray]:

@@ -44,10 +44,15 @@ class BaseParameterServer:
     """
 
     def __init__(self, weights: List[np.ndarray], mode: str = "asynchronous",
-                 port: int = 4000, **_kwargs):
+                 port: int = 4000, fault_plan: Any = None, **_kwargs):
         self.weights = [np.array(w) for w in weights]
         self.mode = mode
         self.port = int(port)
+        # Injection hook (resilience.FaultPlan, duck-typed so this module
+        # never imports the resilience package): lets chaos tests lose
+        # deltas server-side — the push "arrived" but its application is
+        # dropped — and stall reads, independent of any client wrapper.
+        self.fault_plan = fault_plan
         self.lock = threading.Lock()
         self._running = False
         # task_id -> {"attempt": int, "delta": accumulated delta or None}.
@@ -69,6 +74,8 @@ class BaseParameterServer:
                     task_id: Optional[str] = None) -> None:
         from .compression import maybe_decode
 
+        if self.fault_plan is not None and self.fault_plan.drop_server_push():
+            return  # injected server-side loss: the delta is never applied
         delta = maybe_decode(delta)  # transparent: plain lists pass through
 
         def _apply():
@@ -139,6 +146,8 @@ class BaseParameterServer:
             self._attempts.pop(task_id, None)
 
     def get_weights(self) -> List[np.ndarray]:
+        if self.fault_plan is not None:
+            self.fault_plan.delay_server_pull()  # injected slow read
         return self.weights
 
     def start(self) -> None:

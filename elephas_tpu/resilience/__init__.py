@@ -1,0 +1,69 @@
+"""Resilience subsystem: failure as a first-class, testable input.
+
+The paper's asynchronous/hogwild modes make worker failure and staleness a
+*normal* operating condition (DeepSpark, arxiv 1602.08191, scales commodity
+clusters only by tolerating stragglers and partial failure; SparkNet, arxiv
+1511.06051, leans on iterative re-execution). This package makes that
+condition injectable, policed, and recoverable across BOTH pipelines:
+
+- :mod:`~elephas_tpu.resilience.faults` — ``FaultPlan``: a seeded,
+  deterministic fault-injection layer. It wraps parameter clients
+  (``FaultyClient``: dropped/duplicated pushes, delayed pulls, transient
+  socket errors, crash-after-N-pushes), worker partitions
+  (``maybe_crash_partition``: kill a worker mid-partition, once), compiled
+  fit chunks (``tick``), parameter servers (server-side drop hooks), and
+  serving steps (deterministic clock stalls that push requests past their
+  deadlines). Same seed → same faults, so chaos scenarios are pinnable
+  tests, not flakes.
+- :mod:`~elephas_tpu.resilience.policy` — composable ``RetryPolicy``
+  (exponential backoff + deterministic jitter, attempt caps, deadlines)
+  and ``CircuitBreaker`` (closed → open → half-open), plus
+  ``ResilientClient``, which routes any
+  :class:`~elephas_tpu.parameter.client.BaseParameterClient`'s pulls and
+  pushes through both.
+- :mod:`~elephas_tpu.resilience.supervisor` — ``TrainingSupervisor``:
+  wraps ``SparkModel.fit`` with periodic checkpointing
+  (:mod:`elephas_tpu.utils.checkpoint`) and auto-resume from the latest
+  VALID checkpoint after a crash, bounded by ``max_restarts``. Task-level
+  failures stay with the existing stage-scoped exactly-once machinery
+  (``worker.py`` / ``parameter/client.py``); the supervisor handles the
+  layer above it — whole-fit death.
+
+Serving-side resilience (per-request deadlines, ``cancel(request_id)``,
+O(1) slot reclamation on timeout, bounded result retention) lives in
+:mod:`elephas_tpu.serving.engine`; the chaos scenarios for all of it are
+pinned in ``tests/resilience/``.
+"""
+
+from .faults import (
+    FaultPlan,
+    FaultyClient,
+    InjectedFault,
+    InjectedWorkerCrash,
+    TransientFault,
+)
+from .policy import (
+    CircuitBreaker,
+    CircuitOpenError,
+    ResilientClient,
+    RetryExhausted,
+    RetryPolicy,
+    default_is_transient,
+)
+from .supervisor import SupervisorAborted, SupervisorEvent, TrainingSupervisor
+
+__all__ = [
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "FaultPlan",
+    "FaultyClient",
+    "InjectedFault",
+    "InjectedWorkerCrash",
+    "ResilientClient",
+    "RetryExhausted",
+    "RetryPolicy",
+    "SupervisorAborted",
+    "SupervisorEvent",
+    "TrainingSupervisor",
+    "default_is_transient",
+]

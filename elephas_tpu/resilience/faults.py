@@ -1,0 +1,271 @@
+"""Seeded, deterministic fault injection.
+
+Every fault decision is a pure function of ``(seed, site, per-site call
+index)`` via a keyed hash — NOT a shared RNG stream — so concurrent workers
+cannot reorder each other's faults: whatever thread interleaving happens,
+the Nth push on a given site sees the same verdict on every run. That is
+what turns chaos scenarios into pinnable tests (``tests/resilience/``)
+instead of flakes.
+
+Injection points, one per layer the tentpole names:
+
+- **parameter clients** — :class:`FaultyClient` wraps any
+  :class:`~elephas_tpu.parameter.client.BaseParameterClient`: pushes can be
+  dropped (delta lost in the network, at-most-once), duplicated
+  (retransmit, at-least-once), or fail with a :class:`TransientFault`
+  (a ``ConnectionError``, so retry policies treat it as real); pulls can be
+  delayed or fail transiently; and a worker can be killed after its Nth
+  push (``crash_partition``/``crash_after_pushes``) — the async
+  "crash mid-partition" that exercises the server's attempt rollback.
+- **worker partitions** — :meth:`FaultPlan.maybe_crash_partition` kills a
+  synchronous worker mid-partition (work done, result lost), once, on
+  attempt 0, driving the facade's Spark-parity task retry.
+- **compiled fit chunks** — :meth:`FaultPlan.tick` raises at a configured
+  per-site call index (e.g. ``{"fit_chunk": 2}`` kills the 3rd epoch chunk
+  of a checkpointed ``_fit_jax``), once — the whole-fit death the
+  :class:`~elephas_tpu.resilience.supervisor.TrainingSupervisor` recovers
+  from.
+- **parameter servers** — :meth:`FaultPlan.drop_server_push` /
+  :meth:`FaultPlan.delay_server_pull`, consulted by
+  ``BaseParameterServer`` when constructed with ``fault_plan=``.
+- **serving steps** — :meth:`FaultPlan.serving_stall` injects deterministic
+  wall-clock stalls by engine step index; the ``ServingEngine`` adds them
+  to its clock reading, pushing slow requests past their deadlines.
+
+Faults fire AT MOST ONCE per crash site (``fired``/``crash_fired``
+bookkeeping), so retries and supervisor restarts proceed — the injected
+failure is a crash, not a curse.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from ..parameter.client import BaseParameterClient
+
+
+class InjectedFault(Exception):
+    """Base class for every injected failure (mixed into concrete types so
+    ``except InjectedFault`` can tell chaos from genuine breakage)."""
+
+
+class TransientFault(ConnectionError, InjectedFault):
+    """An injected transient network error. Subclasses ``ConnectionError``
+    so retry policies and generic handlers treat it like the real thing."""
+
+
+class InjectedWorkerCrash(RuntimeError, InjectedFault):
+    """An injected worker/partition death (task retry should absorb it)."""
+
+
+def _unit(seed: int, site: str, n: int) -> float:
+    """Deterministic uniform draw in [0, 1) keyed by (seed, site, n)."""
+    digest = hashlib.blake2b(
+        f"{seed}:{site}:{n}".encode(), digest_size=8
+    ).digest()
+    return int.from_bytes(digest, "big") / 2.0**64
+
+
+class FaultPlan:
+    """One seeded plan of everything that will go wrong.
+
+    Rates are probabilities per opportunity; crash sites are exact call
+    indices. All counters are thread-safe, and every decision depends only
+    on the plan's seed and the per-site opportunity index, never on global
+    RNG state or other sites' traffic.
+    """
+
+    def __init__(self, seed: int = 0, *,
+                 drop_push: float = 0.0,
+                 dup_push: float = 0.0,
+                 push_error_rate: float = 0.0,
+                 pull_error_rate: float = 0.0,
+                 pull_delay_s: float = 0.0,
+                 pull_delay_prob: float = 0.0,
+                 crash_partition: Optional[int] = None,
+                 crash_after_pushes: int = 0,
+                 crash_sites: Optional[Dict[str, int]] = None,
+                 server_drop_push: float = 0.0,
+                 server_pull_delay_s: float = 0.0,
+                 serving_stalls: Optional[Dict[int, float]] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.seed = int(seed)
+        self.drop_push = float(drop_push)
+        self.dup_push = float(dup_push)
+        self.push_error_rate = float(push_error_rate)
+        self.pull_error_rate = float(pull_error_rate)
+        self.pull_delay_s = float(pull_delay_s)
+        self.pull_delay_prob = float(pull_delay_prob)
+        self.crash_partition = crash_partition
+        self.crash_after_pushes = int(crash_after_pushes)
+        self.crash_sites = dict(crash_sites or {})
+        self.server_drop_push = float(server_drop_push)
+        self.server_pull_delay_s = float(server_pull_delay_s)
+        self.serving_stalls = dict(serving_stalls or {})
+        self.sleep = sleep
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._push_counts: Dict[Tuple[int, int], int] = {}
+        self.fired: Dict[str, int] = {}      # site -> call index it fired at
+
+    # -- the decision primitive ------------------------------------------
+    def decide(self, site: str, rate: float) -> bool:
+        """Consume one opportunity at ``site``; True with probability
+        ``rate``, deterministically in the site's opportunity index."""
+        if rate <= 0.0:
+            # still consume the index so enabling a rate later keeps other
+            # sites' sequences unchanged? No: a zero rate must be free, or
+            # composing plans changes unrelated decision streams.
+            return False
+        with self._lock:
+            n = self._counters.get(site, 0)
+            self._counters[site] = n + 1
+        return _unit(self.seed, site, n) < rate
+
+    # -- client-side faults ----------------------------------------------
+    def pull_fault(self) -> None:
+        """Apply the pull-side faults: optional delay, optional transient
+        error (raised BEFORE the pull reaches the wire)."""
+        if self.pull_delay_s > 0.0 and (
+            self.pull_delay_prob >= 1.0
+            or self.decide("pull_delay", self.pull_delay_prob)
+        ):
+            self.sleep(self.pull_delay_s)
+        if self.decide("pull_error", self.pull_error_rate):
+            raise TransientFault("injected transient pull failure")
+
+    def push_fault(self) -> str:
+        """Verdict for one push: ``"ok"`` | ``"drop"`` | ``"dup"``; raises
+        :class:`TransientFault` for an injected wire error."""
+        if self.decide("push_error", self.push_error_rate):
+            raise TransientFault("injected transient push failure")
+        if self.decide("drop_push", self.drop_push):
+            return "drop"
+        if self.decide("dup_push", self.dup_push):
+            return "dup"
+        return "ok"
+
+    # -- worker crashes --------------------------------------------------
+    def record_push(self, ctx) -> None:
+        """Count one push for ``ctx``'s (partition, attempt); kill the
+        worker once ``crash_after_pushes`` pushes have gone through
+        (attempt 0 of ``crash_partition`` only, at most once)."""
+        if ctx is None or self.crash_partition is None:
+            return
+        if ctx.partitionId() != self.crash_partition or ctx.attemptNumber():
+            return
+        with self._lock:
+            key = (ctx.partitionId(), ctx.attemptNumber())
+            n = self._push_counts.get(key, 0) + 1
+            self._push_counts[key] = n
+            site = f"crash-partition-{self.crash_partition}"
+            if n <= self.crash_after_pushes or site in self.fired:
+                return
+            self.fired[site] = n
+        raise InjectedWorkerCrash(
+            f"injected crash of partition {ctx.partitionId()} after "
+            f"{self.crash_after_pushes} push(es)"
+        )
+
+    def maybe_crash_partition(self, ctx) -> None:
+        """Kill the worker for ``crash_partition`` mid-partition (attempt 0
+        only, at most once) — the synchronous-path crash, placed by the
+        worker AFTER local training so the computed delta is genuinely
+        lost and must be recomputed by the retry."""
+        if ctx is None or self.crash_partition is None:
+            return
+        if ctx.partitionId() != self.crash_partition or ctx.attemptNumber():
+            return
+        site = f"crash-partition-{self.crash_partition}"
+        with self._lock:
+            if site in self.fired:
+                return
+            self.fired[site] = 0
+        raise InjectedWorkerCrash(
+            f"injected mid-partition crash of partition {ctx.partitionId()}"
+        )
+
+    # -- coarse crash points (fit chunks, arbitrary sites) ---------------
+    def tick(self, site: str) -> None:
+        """Count one call to ``site``; raise :class:`InjectedWorkerCrash`
+        at the call index configured in ``crash_sites`` (0-based), once."""
+        with self._lock:
+            n = self._counters.get(f"tick:{site}", 0)
+            self._counters[f"tick:{site}"] = n + 1
+            target = self.crash_sites.get(site)
+            if target is None or n != target or site in self.fired:
+                return
+            self.fired[site] = n
+        raise InjectedWorkerCrash(
+            f"injected crash at {site!r} call {n}"
+        )
+
+    # -- server-side hooks -----------------------------------------------
+    def drop_server_push(self) -> bool:
+        """True = the server should silently discard this delta (the push
+        'arrived' but its application is lost)."""
+        return self.decide("server_drop_push", self.server_drop_push)
+
+    def delay_server_pull(self) -> None:
+        if self.server_pull_delay_s > 0.0:
+            self.sleep(self.server_pull_delay_s)
+
+    # -- serving ----------------------------------------------------------
+    def serving_stall(self, step_index: int) -> float:
+        """Seconds of injected wall-clock stall at engine step
+        ``step_index`` (deterministic: an explicit step → seconds map)."""
+        return float(self.serving_stalls.get(int(step_index), 0.0))
+
+
+class FaultyClient(BaseParameterClient):
+    """Wrap a parameter client with a :class:`FaultPlan`.
+
+    Sits at the transport layer: whatever stacks above it (compression,
+    :class:`~elephas_tpu.resilience.policy.ResilientClient` retries) sees
+    injected faults exactly as it would see real network ones. Dropped
+    pushes report success to the caller — the delta is lost in flight, the
+    worker never knows, which is precisely the failure mode async training
+    must converge through.
+    """
+
+    def __init__(self, inner: BaseParameterClient, plan: FaultPlan):
+        self.inner = inner
+        self.plan = plan
+
+    def _task_ctx(self):
+        from ..data import TaskContext
+
+        return TaskContext.get()
+
+    def get_parameters(self):
+        self.plan.pull_fault()
+        return self.inner.get_parameters()
+
+    def _push(self, do_push: Callable[[], None]) -> None:
+        self.plan.record_push(self._task_ctx())
+        verdict = self.plan.push_fault()
+        if verdict == "drop":
+            return
+        do_push()
+        if verdict == "dup":
+            do_push()
+
+    def update_parameters(self, delta) -> None:
+        self._push(lambda: self.inner.update_parameters(delta))
+
+    def update_parameters_tagged(self, task_id: str, delta) -> None:
+        self._push(
+            lambda: self.inner.update_parameters_tagged(task_id, delta)
+        )
+
+    def register_attempt(self, task_id: str, attempt: int) -> bool:
+        return self.inner.register_attempt(task_id, attempt)
+
+    def commit_attempt(self, task_id: str) -> None:
+        self.inner.commit_attempt(task_id)
+
+    def close(self) -> None:
+        self.inner.close()

@@ -83,7 +83,7 @@ class FinishedRequest:
     request_id: str
     prompt: np.ndarray            # [T0] int32
     tokens: List[int]             # generated continuation (EOS included)
-    finish_reason: str            # "eos" | "length"
+    finish_reason: str            # "eos" | "length" | "deadline" | "cancelled"
     timing: RequestTiming
 
 
@@ -95,10 +95,21 @@ class ServingEngine:
     def __init__(self, model, params, n_slots: int = 8,
                  max_len: Optional[int] = None, max_queue: int = 64,
                  mesh=None, clock: Callable[[], float] = time.monotonic,
-                 metrics_window: int = 1024):
+                 metrics_window: int = 1024, max_finished: int = 1024,
+                 fault_plan=None):
+        if max_finished < 1:
+            raise ValueError(f"max_finished must be >= 1, got {max_finished}")
         self.model = model
         self.params = params
         self.clock = clock
+        self.max_finished = int(max_finished)
+        # resilience.FaultPlan (duck-typed): serving_stall(step_index)
+        # seconds accumulate into _skew, which every engine-side clock read
+        # adds on — a deterministic "this step took 30s" without sleeping,
+        # which is what pushes a request past its deadline in tests.
+        self.fault_plan = fault_plan
+        self._skew = 0.0
+        self._step_index = 0
         self.scheduler = Scheduler(max_queue=max_queue)
         self.metrics = ServingMetrics(n_slots=n_slots, window=metrics_window)
         if mesh is None:
@@ -126,15 +137,26 @@ class ServingEngine:
         self._finished: Dict[str, FinishedRequest] = {}
         self._next_id = 0
 
+    # -- time ------------------------------------------------------------
+    def _now(self) -> float:
+        """Engine time: the injected clock plus accumulated injected-stall
+        skew (every deadline check and timing stamp reads this, so an
+        injected stall ages EVERYTHING consistently)."""
+        return self.clock() + self._skew
+
     # -- submission ------------------------------------------------------
     def submit(self, prompt, max_new: int, temperature: float = 0.0,
                eos_id: Optional[int] = None, priority: int = 0,
                seed: int = 0, on_token: Optional[Callable] = None,
-               request_id: Optional[str] = None) -> str:
+               request_id: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> str:
         """Enqueue one generation request; returns its id. Raises
         :class:`AdmissionError` (with a machine-readable ``.reason``) on
         validation failure or queue backpressure — rejected work never
-        holds a queue entry or a slot."""
+        holds a queue entry or a slot. ``deadline_s`` bounds the request's
+        whole lifetime from submit: once exceeded it is reaped at the next
+        ``step()`` with ``finish_reason="deadline"`` and whatever tokens it
+        produced, and its slot is reclaimed."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         T0 = prompt.shape[0]
         rid = request_id or f"req-{self._next_id}"
@@ -145,6 +167,10 @@ class ServingEngine:
             if max_new < 1:
                 raise AdmissionError("bad_request",
                                      f"max_new must be >= 1, got {max_new}")
+            if deadline_s is not None and deadline_s <= 0:
+                raise AdmissionError(
+                    "bad_request",
+                    f"deadline_s must be > 0, got {deadline_s}")
             if T0 < 1 or T0 > self.kv.max_len:
                 raise AdmissionError(
                     "prompt_too_long",
@@ -154,12 +180,15 @@ class ServingEngine:
                     "length_exceeds_cache",
                     f"prompt {T0} + max_new {max_new} exceeds "
                     f"max_len {self.kv.max_len}")
+            submitted_at = self._now()
             req = ServingRequest(
                 request_id=rid, prompt=prompt, max_new=int(max_new),
                 temperature=float(temperature), eos_id=eos_id,
                 priority=int(priority), seed=int(seed), on_token=on_token,
+                deadline_at=(None if deadline_s is None
+                             else submitted_at + float(deadline_s)),
                 timing=RequestTiming(request_id=rid, prompt_tokens=int(T0),
-                                     submitted_at=self.clock()))
+                                     submitted_at=submitted_at))
             self.scheduler.push(req)
         except AdmissionError as e:
             self.metrics.observe_reject(e.reason)
@@ -174,14 +203,67 @@ class ServingEngine:
         """Run ONE scheduler action — ``"prefill"`` (admit the next queued
         request into a free slot and emit its first token), ``"decode"``
         (one batched decode step over all slots), or ``"idle"`` — and
-        return which one ran."""
+        return which one ran. Expired deadlines are reaped first, so a
+        timed-out request frees its slot before this step's work is
+        chosen."""
+        if self.fault_plan is not None:
+            self._skew += self.fault_plan.serving_stall(self._step_index)
+        self._step_index += 1
+        self._reap_expired()
         action = self.scheduler.decide(self.kv.free_slots,
                                        self.kv.active_slots)
         if action == "prefill":
-            self._do_prefill(self.scheduler.pop())
+            req = self.scheduler.pop()
+            if req is not None:
+                self._do_prefill(req)
         elif action == "decode":
             self._do_decode()
         return action
+
+    # -- early termination ------------------------------------------------
+    def cancel(self, request_id: str) -> bool:
+        """Terminate a queued or in-flight request NOW: its slot (if any)
+        is reclaimed in O(1), a terminal record with
+        ``finish_reason="cancelled"`` and the tokens generated so far is
+        filed, and the id becomes reusable. Returns False for ids that are
+        not live (already finished, or unknown)."""
+        req = self._requests.get(request_id)
+        if req is None:
+            return False
+        self._finish_early(req, "cancelled")
+        return True
+
+    def _reap_expired(self) -> None:
+        now = self._now()
+        for req in list(self._requests.values()):
+            if req.deadline_at is not None and now >= req.deadline_at:
+                self._finish_early(req, "deadline")
+
+    def _finish_early(self, req: ServingRequest, reason: str) -> None:
+        """Shared teardown for cancel/deadline: release device + host state
+        and file the terminal record. O(1): SlotKVCache.release is a
+        free-list push (no cache rewrite — the staleness-repair invariant
+        makes the dead rows harmless), and queued entries are tombstoned,
+        not re-heapified."""
+        if req.slot is None:
+            self.scheduler.discard(req)
+        else:
+            slot = req.slot
+            self._slot_req.pop(slot, None)
+            self.kv.release(slot)
+            # park the slot as a pos-0 greedy no-op row until reassigned
+            self._tok[slot] = 0
+            self._temps[slot] = 0.0
+            self._keys[slot] = 0
+        self._requests.pop(req.request_id, None)
+        req.timing.finished_at = self._now()
+        req.timing.generated_tokens = len(req.generated)
+        req.timing.finish_reason = reason
+        self.metrics.observe_cancel(reason)
+        self._file_finished(FinishedRequest(
+            request_id=req.request_id, prompt=req.prompt,
+            tokens=list(req.generated), finish_reason=reason,
+            timing=req.timing))
 
     def drain(self, max_steps: Optional[int] = None
               ) -> Dict[str, FinishedRequest]:
@@ -195,8 +277,24 @@ class ServingEngine:
                 break
         return dict(self._finished)
 
-    def result(self, request_id: str) -> Optional[FinishedRequest]:
+    def result(self, request_id: str,
+               pop: bool = True) -> Optional[FinishedRequest]:
+        """Fetch (and by default REMOVE) a terminal record. Pop-on-read is
+        the retention contract for long-running servers: a result read once
+        is not re-buffered. Pass ``pop=False`` to peek."""
+        if pop:
+            return self._finished.pop(request_id, None)
         return self._finished.get(request_id)
+
+    def _file_finished(self, fin: FinishedRequest) -> None:
+        """Record a terminal request, evicting the OLDEST retained results
+        past ``max_finished`` — unread results are dropped rather than
+        accumulated forever (the pre-cap behavior leaked one record per
+        request for the life of the server)."""
+        self._finished[fin.request_id] = fin
+        while len(self._finished) > self.max_finished:
+            self._finished.pop(next(iter(self._finished)))
+            self.metrics.observe_result_evicted()
 
     def snapshot(self) -> Dict[str, object]:
         """Engine + request metrics as one JSON-able dict."""
@@ -207,7 +305,7 @@ class ServingEngine:
     # -- internals -------------------------------------------------------
     def _do_prefill(self, req: ServingRequest) -> None:
         slot = self.kv.allocate()
-        req.timing.admitted_at = self.clock()
+        req.timing.admitted_at = self._now()
         last = self.kv.insert(slot, req.prompt, insert_fn=self._insert_fn)
         self.metrics.observe_prefill()
         T0 = int(req.prompt.shape[0])
@@ -216,7 +314,7 @@ class ServingEngine:
                                 jnp.asarray(key)))
         req.slot = slot
         req.next_pos = T0           # position `tok` occupies
-        req.timing.first_token_at = self.clock()
+        req.timing.first_token_at = self._now()
         self._slot_req[slot] = req
         self._tok[slot] = tok
         self._temps[slot] = req.temperature
@@ -248,14 +346,14 @@ class ServingEngine:
         if not done:
             self._tok[req.slot] = tok
             return
-        req.timing.finished_at = self.clock()
+        req.timing.finished_at = self._now()
         req.timing.generated_tokens = len(req.generated)
         req.timing.finish_reason = "eos" if done_eos else "length"
         self.metrics.observe_finish(req.timing)
-        self._finished[req.request_id] = FinishedRequest(
+        self._file_finished(FinishedRequest(
             request_id=req.request_id, prompt=req.prompt,
             tokens=list(req.generated),
-            finish_reason=req.timing.finish_reason, timing=req.timing)
+            finish_reason=req.timing.finish_reason, timing=req.timing))
         slot = req.slot
         self._slot_req.pop(slot, None)
         self._requests.pop(req.request_id, None)

@@ -35,7 +35,7 @@ class RequestTiming:
     first_token_at: Optional[float] = None   # first generated token emitted
     finished_at: Optional[float] = None
     generated_tokens: int = 0
-    finish_reason: Optional[str] = None      # "eos" | "length"
+    finish_reason: Optional[str] = None      # "eos"|"length"|"deadline"|"cancelled"
 
     @property
     def queue_wait(self) -> Optional[float]:
@@ -82,6 +82,8 @@ class ServingMetrics:
     submitted: int = 0
     rejected: Counter = field(default_factory=Counter)  # reason → count
     completed: int = 0
+    cancelled: Counter = field(default_factory=Counter)  # reason → count
+    results_evicted: int = 0  # finished records dropped by the retention cap
     tokens_generated: int = 0
     prefills: int = 0
     decode_steps: int = 0
@@ -90,6 +92,14 @@ class ServingMetrics:
 
     def observe_reject(self, reason: str) -> None:
         self.rejected[reason] += 1
+
+    def observe_cancel(self, reason: str) -> None:
+        """One request terminated early: ``"deadline"`` (engine reaped it)
+        or ``"cancelled"`` (caller asked)."""
+        self.cancelled[reason] += 1
+
+    def observe_result_evicted(self) -> None:
+        self.results_evicted += 1
 
     def observe_submit(self) -> None:
         self.submitted += 1
@@ -145,6 +155,8 @@ class ServingMetrics:
                 "submitted": self.submitted,
                 "rejected": dict(self.rejected),
                 "completed": self.completed,
+                "cancelled": dict(self.cancelled),
+                "results_evicted": self.results_evicted,
                 "tokens_generated": self.tokens_generated,
             },
             "requests": {

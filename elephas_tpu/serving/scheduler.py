@@ -53,6 +53,11 @@ class ServingRequest:
     seed: int = 0
     on_token: Optional[Callable] = None  # (request_id, token, done) -> None
     timing: Optional[RequestTiming] = None
+    # resilience: absolute deadline (engine-clock units) and the lazy-
+    # deletion tombstone — a cancelled entry stays in the heap but is
+    # skipped at pop (O(1) cancel, no heap rebuild)
+    deadline_at: Optional[float] = None
+    cancelled: bool = False
     # engine-managed decode state
     slot: Optional[int] = None
     carry: Optional[int] = None    # last emitted token, not yet in cache
@@ -68,33 +73,59 @@ class Scheduler:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         self.max_queue = int(max_queue)
         self._heap: List[Tuple[int, int, ServingRequest]] = []
+        self._live = 0                 # heap entries NOT tombstoned
         self._seq = itertools.count()  # FIFO tiebreak within a priority
 
     def __len__(self) -> int:
-        return len(self._heap)
+        return self._live
 
     @property
     def queue_depth(self) -> int:
-        return len(self._heap)
+        return self._live
 
     def push(self, req: ServingRequest) -> None:
         """Enqueue or reject-with-reason (the backpressure point)."""
-        if len(self._heap) >= self.max_queue:
+        if self._live >= self.max_queue:
             raise AdmissionError(
                 "queue_full",
-                f"{len(self._heap)} waiting >= max_queue {self.max_queue}")
+                f"{self._live} waiting >= max_queue {self.max_queue}")
         # negated priority: heapq is a min-heap, higher priority runs first
         heapq.heappush(self._heap, (-int(req.priority), next(self._seq), req))
+        self._live += 1
 
     def pop(self) -> Optional[ServingRequest]:
-        if not self._heap:
-            return None
-        return heapq.heappop(self._heap)[2]
+        while self._heap:
+            req = heapq.heappop(self._heap)[2]
+            if req.cancelled:
+                continue  # tombstone: already discarded, heap entry stale
+            self._live -= 1
+            return req
+        return None
+
+    def discard(self, req: ServingRequest) -> bool:
+        """Cancel a QUEUED request in O(1): tombstone it, fix the live
+        count, leave the heap entry for ``pop`` to skip. Returns False if
+        the request was already cancelled (idempotent)."""
+        if req.cancelled:
+            return False
+        req.cancelled = True
+        self._live -= 1
+        return True
+
+    def expired(self, now: float) -> List[ServingRequest]:
+        """Queued requests whose deadline has passed (NOT yet discarded —
+        the caller decides what a timeout means)."""
+        return [
+            entry[2] for entry in self._heap
+            if not entry[2].cancelled
+            and entry[2].deadline_at is not None
+            and now >= entry[2].deadline_at
+        ]
 
     def decide(self, free_slots: int, active_slots: int) -> str:
         """The next engine action: ``"prefill"`` (waiting work + a free
         slot), else ``"decode"`` (any active slot), else ``"idle"``."""
-        if self._heap and free_slots > 0:
+        if self._live and free_slots > 0:
             return "prefill"
         if active_slots > 0:
             return "decode"

@@ -73,6 +73,8 @@ class SparkModel:
                  comm: Optional[str] = None, remat: bool = False,
                  compression: Optional[str] = None,
                  master_optimizer=None, master_loss=None, master_metrics=None,
+                 fault_plan=None, retry_policy=None,
+                 ps_timeout: float = 60.0,
                  *args, **kwargs):
         if mode not in ("synchronous", "asynchronous", "hogwild"):
             raise ValueError(f"Unknown mode: {mode}")
@@ -124,6 +126,14 @@ class SparkModel:
             master_loss if master_loss is not None else getattr(model, "loss", None)
         )
         self.master_metrics = master_metrics
+        # Resilience extensions (elephas_tpu.resilience): a seeded FaultPlan
+        # injects failures into workers/clients/servers, a RetryPolicy
+        # routes host-PS traffic through backoff+breaker, and ps_timeout
+        # replaces the reference's five hard-coded 60s wire timeouts.
+        self.fault_plan = fault_plan
+        self.retry_policy = retry_policy
+        self.ps_timeout = float(ps_timeout)
+        self._fit_kwargs: Dict[str, Any] = {}
         self.training_histories: List[Dict[str, Any]] = []
         self.timings: List[Dict[str, float]] = []
         self._server = None
@@ -177,6 +187,9 @@ class SparkModel:
         if rdd.getNumPartitions() != num_workers:
             rdd = rdd.repartition(num_workers)
         self._checkpoint = (checkpoint_dir, checkpoint_frequency, resume)
+        # Extra Keras fit kwargs (e.g. shuffle=False) ride along to the
+        # host-path workers' model.fit; the compiled path ignores them.
+        self._fit_kwargs = dict(kwargs)
         if profile_dir is not None:
             import jax
 
@@ -263,6 +276,8 @@ class SparkModel:
         checkpoint_dir, checkpoint_frequency, resume = self._checkpoint
 
         if checkpoint_dir is None:
+            if self.fault_plan is not None:
+                self.fault_plan.tick("fit_chunk")
             result = trainer.fit(
                 blocks, epochs=epochs, batch_size=batch_size,
                 validation_split=validation_split, verbose=verbose,
@@ -317,6 +332,12 @@ class SparkModel:
         epoch = start_epoch
         while epoch < epochs:
             chunk = min(checkpoint_frequency, epochs - epoch)
+            if self.fault_plan is not None:
+                # One crash opportunity per fit chunk: crash_sites=
+                # {"fit_chunk": k} kills the (k+1)th chunk AFTER the
+                # previous chunk's checkpoint is durable — the supervisor's
+                # auto-resume scenario.
+                self.fault_plan.tick("fit_chunk")
             if sync_faithful:
                 # seed stays 0 and the GLOBAL epoch index is folded inside
                 # the program, matching the uninterrupted fit's shuffles
@@ -360,12 +381,13 @@ class SparkModel:
             "batch_size": batch_size,
             "verbose": verbose,
             "validation_split": validation_split,
+            **self._fit_kwargs,
         }
         parameters = rdd.context.broadcast(model.get_weights())
         worker = SparkWorker(
             model.to_json(), parameters, train_config,
             self.master_optimizer, self.master_loss, self.master_metrics,
-            self.custom_objects,
+            self.custom_objects, fault_plan=self.fault_plan,
         )
         results = rdd.mapPartitions(worker.train).collect()
         deltas = [r[0] for r in results]
@@ -394,7 +416,10 @@ class SparkModel:
             cls = HttpServer
         else:
             cls = SocketServer
-        self._server = cls(weights, mode=self.mode, port=self.port)
+        self._server = cls(
+            weights, mode=self.mode, port=self.port,
+            fault_plan=self.fault_plan,
+        )
         self._server.start()
         self.port = self._server.port  # native server may bind an OS port
 
@@ -404,22 +429,35 @@ class SparkModel:
             from .parameter.native import NativeClient
 
             weights = self._master_network.get_weights()
-            return NativeClient(
+            client = NativeClient(
                 [w.shape for w in weights], [w.dtype for w in weights],
                 self.port,
                 # fresh codec per client: top-k error-feedback residual is
                 # per-worker state (mirrors the http/socket wrapper below)
                 codec=make_codec(self.compression),
             )
-        client = BaseParameterClient.get_client(
-            self.parameter_server_mode, self.port, host="127.0.0.1"
-        )
-        if self.compression:
-            from .parameter.compression import CompressingClient, make_codec
+        else:
+            client = BaseParameterClient.get_client(
+                self.parameter_server_mode, self.port, host="127.0.0.1",
+                timeout=self.ps_timeout,
+            )
+            if self.fault_plan is not None:
+                from .resilience.faults import FaultyClient
 
-            # fresh codec per client: top-k error-feedback residual is
-            # per-worker state (one client per executor, like the reference)
-            client = CompressingClient(client, make_codec(self.compression))
+                # Transport layer: everything stacked above (compression,
+                # retries) sees injected faults as real network ones.
+                client = FaultyClient(client, self.fault_plan)
+            if self.compression:
+                from .parameter.compression import CompressingClient, make_codec
+
+                # fresh codec per client: top-k error-feedback residual is
+                # per-worker state (one client per executor, like the
+                # reference)
+                client = CompressingClient(client, make_codec(self.compression))
+        if self.retry_policy is not None:
+            from .resilience.policy import ResilientClient
+
+            client = ResilientClient(client, policy=self.retry_policy)
         return client
 
     def stop_server(self) -> None:
@@ -436,6 +474,7 @@ class SparkModel:
                 "batch_size": batch_size,
                 "verbose": verbose,
                 "validation_split": validation_split,
+                **self._fit_kwargs,
             }
 
             def make_train(json_config, make_client, train_config, frequency,

@@ -194,4 +194,23 @@ def load_checkpoint(directory: str) -> Tuple[List[np.ndarray], Dict[str, Any], A
 
 
 def has_checkpoint(directory: str) -> bool:
-    return os.path.exists(os.path.join(directory, "meta.json"))
+    """True only for a checkpoint :func:`load_checkpoint` can actually
+    read: ``meta.json`` must parse AND ``weights.npz`` must exist.
+
+    ``meta.json`` is written last (the commit point), so its mere presence
+    USUALLY implies a complete checkpoint — but a crash mid-``json.dump``
+    leaves a truncated meta, and an auto-resume supervisor probing with
+    this function must treat any such partial directory as "no
+    checkpoint", not die trying to resume from it.
+    """
+    meta_path = os.path.join(directory, "meta.json")
+    if not os.path.exists(meta_path):
+        return False
+    if not os.path.exists(os.path.join(directory, "weights.npz")):
+        return False
+    try:
+        with open(meta_path) as f:
+            json.load(f)
+    except (json.JSONDecodeError, OSError):
+        return False
+    return True

@@ -73,7 +73,7 @@ class SparkWorker:
 
     def __init__(self, json_config: str, parameters, train_config: Dict[str, Any],
                  master_optimizer, master_loss, master_metrics,
-                 custom_objects: Optional[dict] = None):
+                 custom_objects: Optional[dict] = None, fault_plan=None):
         self.json_config = json_config
         self.parameters = parameters  # Broadcast of initial weights
         self.train_config = dict(train_config)
@@ -81,6 +81,10 @@ class SparkWorker:
         self.master_loss = master_loss
         self.master_metrics = master_metrics
         self.custom_objects = custom_objects
+        # resilience.FaultPlan (duck-typed): lets chaos tests kill this
+        # worker mid-partition — after local training, before the delta is
+        # yielded — so the task retry must recompute everything.
+        self.fault_plan = fault_plan
         self.history = None
 
     def train(self, data_iterator: Iterator):
@@ -104,6 +108,12 @@ class SparkWorker:
         history = keras_history.history if keras_history is not None else None
         self.history = history
         deltas = subtract_params_np(weights_before, model.get_weights())
+        if self.fault_plan is not None:
+            from .data import TaskContext
+
+            # Crash point sits AFTER the fit: the work is done, the result
+            # is lost — the worst-timed death a task retry must absorb.
+            self.fault_plan.maybe_crash_partition(TaskContext.get())
         yield deltas, history
 
 

@@ -28,7 +28,8 @@ export ELEPHAS_WATCHDOG_FILE="$WATCHDOG_FILE"
 # Top-level shards: every directory under tests/ plus tests/ itself
 # non-recursively (pytest.ini-style rootdir files). New test trees are
 # picked up automatically — tests/serving/ (the continuous-batching
-# engine) runs as its own shard like models/ops/parallel.
+# engine) and tests/resilience/ (fault-injection chaos scenarios) run as
+# their own shards like models/ops/parallel.
 shards=()
 for d in tests/*/; do
   [ -d "$d" ] && [ -n "$(find "$d" -name 'test_*.py' -print -quit)" ] \

@@ -1,0 +1,196 @@
+"""FaultPlan: every decision must be a deterministic function of
+(seed, site, opportunity index) — same plan seed, same faults, any thread
+interleaving — and every crash site must fire at most once."""
+
+import pytest
+
+from elephas_tpu.resilience import (
+    FaultPlan,
+    FaultyClient,
+    InjectedFault,
+    InjectedWorkerCrash,
+    TransientFault,
+)
+
+pytestmark = pytest.mark.resilience
+
+
+class FakeCtx:
+    """Stand-in for elephas_tpu.data.TaskContext."""
+
+    def __init__(self, partition=0, attempt=0, stage=1):
+        self._p, self._a, self._s = partition, attempt, stage
+
+    def partitionId(self):
+        return self._p
+
+    def attemptNumber(self):
+        return self._a
+
+    def stageId(self):
+        return self._s
+
+
+class RecordingClient:
+    """Inner parameter client that just records traffic."""
+
+    def __init__(self):
+        self.pulls = 0
+        self.pushes = []
+        self.closed = False
+
+    def get_parameters(self):
+        self.pulls += 1
+        return ["weights"]
+
+    def update_parameters(self, delta):
+        self.pushes.append(("plain", delta))
+
+    def update_parameters_tagged(self, task_id, delta):
+        self.pushes.append((task_id, delta))
+
+    def register_attempt(self, task_id, attempt):
+        return True
+
+    def commit_attempt(self, task_id):
+        pass
+
+    def close(self):
+        self.closed = True
+
+
+def test_same_seed_same_decisions():
+    a = FaultPlan(seed=7, drop_push=0.3, dup_push=0.1)
+    b = FaultPlan(seed=7, drop_push=0.3, dup_push=0.1)
+    assert [a.push_fault() for _ in range(64)] == \
+        [b.push_fault() for _ in range(64)]
+
+
+def test_different_seeds_differ():
+    a = FaultPlan(seed=0, drop_push=0.5)
+    b = FaultPlan(seed=1, drop_push=0.5)
+    assert [a.push_fault() for _ in range(64)] != \
+        [b.push_fault() for _ in range(64)]
+
+
+def test_sites_are_independent_streams():
+    """Traffic at one site must not shift another site's decisions —
+    that's what makes concurrent-worker chaos runs reproducible."""
+    quiet = FaultPlan(seed=3, drop_push=0.4)
+    quiet_seq = [quiet.decide("drop_push", 0.4) for _ in range(32)]
+
+    noisy = FaultPlan(seed=3, drop_push=0.4)
+    for _ in range(100):
+        noisy.decide("other_site", 0.5)     # unrelated traffic first
+    assert quiet_seq == [noisy.decide("drop_push", 0.4) for _ in range(32)]
+
+
+def test_rate_bounds():
+    plan = FaultPlan(seed=5)
+    assert not any(plan.decide("never", 0.0) for _ in range(50))
+    assert all(plan.decide("always", 1.0) for _ in range(50))
+
+
+def test_drop_rate_roughly_honored():
+    plan = FaultPlan(seed=11, drop_push=0.2)
+    drops = sum(plan.push_fault() == "drop" for _ in range(500))
+    assert 60 <= drops <= 140                # 0.2 ± generous slack
+
+
+def test_faulty_client_drop_and_dup():
+    drop_all = FaultyClient(RecordingClient(), FaultPlan(seed=0, drop_push=1.0))
+    drop_all.update_parameters([1.0])
+    assert drop_all.inner.pushes == []       # lost in flight, no error
+
+    dup_all = FaultyClient(RecordingClient(), FaultPlan(seed=0, dup_push=1.0))
+    dup_all.update_parameters_tagged("t", [1.0])
+    assert dup_all.inner.pushes == [("t", [1.0]), ("t", [1.0])]
+
+
+def test_faulty_client_transient_errors():
+    plan = FaultPlan(seed=0, push_error_rate=1.0, pull_error_rate=1.0)
+    client = FaultyClient(RecordingClient(), plan)
+    with pytest.raises(TransientFault):
+        client.update_parameters([1.0])
+    with pytest.raises(TransientFault):
+        client.get_parameters()
+    assert client.inner.pushes == [] and client.inner.pulls == 0
+    # a TransientFault must look like a real network error to handlers
+    assert issubclass(TransientFault, ConnectionError)
+    assert issubclass(TransientFault, InjectedFault)
+
+
+def test_pull_delay_uses_injected_sleep():
+    slept = []
+    plan = FaultPlan(seed=0, pull_delay_s=2.5, pull_delay_prob=1.0,
+                     sleep=slept.append)
+    client = FaultyClient(RecordingClient(), plan)
+    client.get_parameters()
+    assert slept == [2.5]
+    assert client.inner.pulls == 1
+
+
+def test_crash_after_pushes_fires_once_attempt0_only():
+    plan = FaultPlan(seed=0, crash_partition=1, crash_after_pushes=2)
+    client = FaultyClient(RecordingClient(), plan)
+    ctx = FakeCtx(partition=1, attempt=0)
+    client._task_ctx = lambda: ctx           # bypass thread-local lookup
+    client.update_parameters([1])
+    client.update_parameters([2])
+    with pytest.raises(InjectedWorkerCrash):
+        client.update_parameters([3])
+    assert len(client.inner.pushes) == 2     # the third never went out
+    # the retry (attempt 1) sails through — fault fired once
+    client._task_ctx = lambda: FakeCtx(partition=1, attempt=1)
+    for i in range(5):
+        client.update_parameters([i])
+    assert len(client.inner.pushes) == 7
+
+
+def test_crash_ignores_other_partitions():
+    plan = FaultPlan(seed=0, crash_partition=1, crash_after_pushes=0)
+    client = FaultyClient(RecordingClient(), plan)
+    client._task_ctx = lambda: FakeCtx(partition=0, attempt=0)
+    for i in range(5):
+        client.update_parameters([i])
+    assert len(client.inner.pushes) == 5
+
+
+def test_maybe_crash_partition_once():
+    plan = FaultPlan(seed=0, crash_partition=2)
+    with pytest.raises(InjectedWorkerCrash):
+        plan.maybe_crash_partition(FakeCtx(partition=2, attempt=0))
+    # retry attempt AND a hypothetical second attempt-0 call both survive
+    plan.maybe_crash_partition(FakeCtx(partition=2, attempt=1))
+    plan.maybe_crash_partition(FakeCtx(partition=2, attempt=0))
+    plan.maybe_crash_partition(None)         # driver-side: no ctx, no crash
+
+
+def test_tick_fires_at_exact_index_once():
+    plan = FaultPlan(seed=0, crash_sites={"fit_chunk": 2})
+    plan.tick("fit_chunk")
+    plan.tick("fit_chunk")
+    with pytest.raises(InjectedWorkerCrash):
+        plan.tick("fit_chunk")               # 0-based call index 2
+    plan.tick("fit_chunk")                   # fired once; restarts proceed
+    plan.tick("other_site")                  # unconfigured sites never fire
+    assert plan.fired == {"fit_chunk": 2}
+
+
+def test_server_hooks_and_serving_stalls():
+    slept = []
+    plan = FaultPlan(seed=0, server_drop_push=1.0, server_pull_delay_s=0.5,
+                     serving_stalls={3: 40.0}, sleep=slept.append)
+    assert plan.drop_server_push()
+    plan.delay_server_pull()
+    assert slept == [0.5]
+    assert plan.serving_stall(3) == 40.0
+    assert plan.serving_stall(2) == 0.0
+
+
+def test_faulty_client_delegates_lifecycle():
+    client = FaultyClient(RecordingClient(), FaultPlan(seed=0))
+    assert client.register_attempt("t", 0)
+    client.commit_attempt("t")
+    client.close()
+    assert client.inner.closed

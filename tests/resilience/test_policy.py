@@ -1,0 +1,225 @@
+"""RetryPolicy / CircuitBreaker / ResilientClient: schedules are
+deterministic and injectable (no real sleeping in any of these tests),
+transient-vs-fatal classification is exact, and the breaker's state
+machine walks closed → open → half-open → closed."""
+
+import urllib.error
+
+import pytest
+
+from elephas_tpu.resilience import (
+    CircuitBreaker,
+    CircuitOpenError,
+    ResilientClient,
+    RetryExhausted,
+    RetryPolicy,
+    TransientFault,
+    default_is_transient,
+)
+
+pytestmark = pytest.mark.resilience
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class FlakyClient:
+    """Inner client whose pull fails ``fail_pulls`` times, then succeeds."""
+
+    def __init__(self, fail_pulls=0, fail_pushes=0):
+        self.fail_pulls = fail_pulls
+        self.fail_pushes = fail_pushes
+        self.pulls = 0
+        self.pushes = 0
+
+    def get_parameters(self):
+        self.pulls += 1
+        if self.pulls <= self.fail_pulls:
+            raise ConnectionResetError("flaky pull")
+        return ["weights"]
+
+    def update_parameters(self, delta):
+        self.pushes += 1
+        if self.pushes <= self.fail_pushes:
+            raise ConnectionResetError("flaky push")
+
+    def update_parameters_tagged(self, task_id, delta):
+        self.update_parameters(delta)
+
+    def register_attempt(self, task_id, attempt):
+        return True
+
+    def commit_attempt(self, task_id):
+        pass
+
+    def close(self):
+        pass
+
+
+def _policy(**kw):
+    kw.setdefault("sleep", lambda s: None)
+    return RetryPolicy(**kw)
+
+
+def test_transient_classification():
+    assert default_is_transient(ConnectionResetError())
+    assert default_is_transient(TimeoutError())
+    assert default_is_transient(urllib.error.URLError("down"))
+    assert default_is_transient(OSError("pipe"))
+    assert default_is_transient(TransientFault("injected"))
+    assert default_is_transient(CircuitOpenError("open"))
+    assert not default_is_transient(ValueError("bug"))
+    assert not default_is_transient(RuntimeError("crash"))
+
+
+def test_retry_recovers_after_transients():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionResetError("not yet")
+        return "ok"
+
+    assert _policy(max_attempts=5).call(fn) == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_exhausted_keeps_cause():
+    policy = _policy(max_attempts=3)
+    with pytest.raises(RetryExhausted) as exc:
+        policy.call(lambda: (_ for _ in ()).throw(TimeoutError("slow")))
+    assert isinstance(exc.value.__cause__, TimeoutError)
+
+
+def test_non_transient_propagates_immediately():
+    calls = []
+
+    def fn():
+        calls.append(1)
+        raise ValueError("a real bug")
+
+    with pytest.raises(ValueError):
+        _policy(max_attempts=5).call(fn)
+    assert len(calls) == 1                   # no retry on program errors
+
+
+def test_backoff_schedule_deterministic_and_capped():
+    a = RetryPolicy(seed=9, base_delay_s=0.1, multiplier=2.0,
+                    max_delay_s=0.5, jitter=0.5)
+    b = RetryPolicy(seed=9, base_delay_s=0.1, multiplier=2.0,
+                    max_delay_s=0.5, jitter=0.5)
+    delays = [a.delay(i) for i in range(8)]
+    assert delays == [b.delay(i) for i in range(8)]   # reproducible
+    assert all(0.0 < d <= 0.5 for d in delays)        # capped, jitter < 100%
+    assert RetryPolicy(seed=9, jitter=0.0).delay(1) == 0.1  # pure exponential
+    assert RetryPolicy(seed=1).delay(0) != RetryPolicy(seed=2).delay(0)
+
+
+def test_retry_sleeps_the_scheduled_delays():
+    slept = []
+    policy = RetryPolicy(max_attempts=3, jitter=0.0, base_delay_s=0.05,
+                         sleep=slept.append)
+    with pytest.raises(RetryExhausted):
+        policy.call(lambda: (_ for _ in ()).throw(ConnectionError()))
+    assert slept == [policy.delay(0), policy.delay(1)]  # no sleep after last
+
+
+def test_deadline_cuts_retries_short():
+    clock = FakeClock()
+
+    def fn():
+        clock.t += 10.0                      # each attempt burns 10s
+        raise ConnectionError("down")
+
+    policy = _policy(max_attempts=100, deadline_s=25.0, clock=clock,
+                     base_delay_s=0.0, jitter=0.0)
+    with pytest.raises(RetryExhausted) as exc:
+        policy.call(fn, describe="pull")
+    assert "deadline" in str(exc.value)
+    assert clock.t <= 40.0                   # gave up instead of spinning
+
+
+def test_breaker_opens_after_threshold_and_fails_fast():
+    clock = FakeClock()
+    cb = CircuitBreaker(failure_threshold=3, reset_timeout_s=5.0,
+                        clock=clock)
+    for _ in range(3):
+        with pytest.raises(ConnectionError):
+            cb.call(lambda: (_ for _ in ()).throw(ConnectionError()))
+    assert cb.state == CircuitBreaker.OPEN
+    calls = []
+    with pytest.raises(CircuitOpenError):
+        cb.call(lambda: calls.append(1))     # rejected without calling
+    assert calls == []
+
+
+def test_breaker_half_open_probe_closes_or_reopens():
+    clock = FakeClock()
+    cb = CircuitBreaker(failure_threshold=1, reset_timeout_s=5.0,
+                        clock=clock)
+    with pytest.raises(ConnectionError):
+        cb.call(lambda: (_ for _ in ()).throw(ConnectionError()))
+    assert cb.state == CircuitBreaker.OPEN
+    clock.t = 6.0
+    assert cb.state == CircuitBreaker.HALF_OPEN
+    # failed probe → straight back to open
+    with pytest.raises(ConnectionError):
+        cb.call(lambda: (_ for _ in ()).throw(ConnectionError()))
+    assert cb.state == CircuitBreaker.OPEN
+    clock.t = 12.0
+    assert cb.call(lambda: "ok") == "ok"     # good probe closes it
+    assert cb.state == CircuitBreaker.CLOSED
+
+
+def test_breaker_half_open_admits_single_probe():
+    clock = FakeClock()
+    cb = CircuitBreaker(failure_threshold=1, reset_timeout_s=1.0,
+                        clock=clock)
+    cb.record_failure()
+    clock.t = 2.0
+    assert cb.allow()                        # the probe slot
+    assert not cb.allow()                    # concurrent caller fails fast
+    cb.record_success()
+    assert cb.allow()
+
+
+def test_resilient_client_rides_through_flaky_wire():
+    inner = FlakyClient(fail_pulls=2, fail_pushes=1)
+    client = ResilientClient(inner, policy=_policy(max_attempts=5))
+    assert client.get_parameters() == ["weights"]
+    client.update_parameters([1.0])
+    assert inner.pulls == 3 and inner.pushes == 2
+
+
+def test_resilient_client_breaker_outage_and_recovery():
+    """A dead server trips the breaker (fail-fast), and the retry policy
+    backs off across the reset window to the half-open probe — the worker
+    resumes without ever seeing the outage."""
+    clock = FakeClock()
+    inner = FlakyClient(fail_pulls=2)
+    breaker = CircuitBreaker(failure_threshold=2, reset_timeout_s=5.0,
+                             clock=clock)
+
+    def sleep(s):
+        clock.t += max(s, 6.0)               # each backoff outlives the reset
+
+    client = ResilientClient(
+        inner,
+        policy=RetryPolicy(max_attempts=6, sleep=sleep, clock=clock),
+        breaker=breaker)
+    assert client.get_parameters() == ["weights"]
+    assert breaker.state == CircuitBreaker.CLOSED
+
+
+def test_resilient_client_gives_up_cleanly():
+    inner = FlakyClient(fail_pulls=100)
+    client = ResilientClient(inner, policy=_policy(max_attempts=3))
+    with pytest.raises(RetryExhausted):
+        client.get_parameters()
+    assert inner.pulls == 3

@@ -1,0 +1,145 @@
+"""TrainingSupervisor lifecycle: crash → resume-from-latest-valid-
+checkpoint → complete, bounded by the restart budget. The fit itself is a
+recording stub here (the real-training end-to-end runs live in
+test_chaos.py); what these tests pin is the supervisor's own contract —
+what it resumes from, when it gives up, and what it reports."""
+
+import json
+
+import numpy as np
+import pytest
+
+from elephas_tpu.resilience import (
+    RetryPolicy,
+    SupervisorAborted,
+    TrainingSupervisor,
+)
+from elephas_tpu.utils.checkpoint import load_checkpoint
+
+pytestmark = pytest.mark.resilience
+
+
+class FakeNet:
+    """One weight that counts trained epochs — resume math is exact."""
+
+    def __init__(self):
+        self._w = [np.zeros((1,), np.float32)]
+
+    def get_weights(self):
+        return [w.copy() for w in self._w]
+
+    def set_weights(self, ws):
+        self._w = [np.asarray(w, np.float32).copy() for w in ws]
+
+
+class FakeHostModel:
+    """SparkModel stand-in on the host path: fit(epochs=k) adds k to the
+    weight; optionally crashes on its Nth fit call."""
+
+    comm = "host"
+    mode = "synchronous"
+
+    def __init__(self, crash_on_call=None):
+        self.master_network = FakeNet()
+        self.fit_calls = 0
+        self.crash_on_call = crash_on_call
+
+    def fit(self, rdd, epochs=1, **kwargs):
+        self.fit_calls += 1
+        if self.fit_calls == self.crash_on_call:
+            raise RuntimeError("injected fit crash")
+        self.master_network._w = [
+            w + epochs for w in self.master_network._w
+        ]
+
+
+class AlwaysCrashModel:
+    comm = "jax"
+
+    def fit(self, rdd, **kwargs):
+        raise RuntimeError("always dies")
+
+
+def _events(sup):
+    return [e.kind for e in sup.events]
+
+
+def test_clean_run_checkpoints_and_completes(tmp_path):
+    model = FakeHostModel()
+    sup = TrainingSupervisor(model, str(tmp_path / "ck"),
+                             checkpoint_frequency=2)
+    sup.fit(rdd=None, epochs=4)
+    assert sup.restarts == 0
+    assert _events(sup) == ["start", "complete"]
+    assert model.master_network._w[0][0] == 4.0
+    weights, meta, _ = load_checkpoint(str(tmp_path / "ck"))
+    assert meta["epoch"] == 4 and weights[0][0] == 4.0
+
+
+def test_crash_resumes_from_latest_checkpoint(tmp_path):
+    # freq=1, epochs=4, crash on the 3rd fit call: epochs 1 and 2 are
+    # checkpointed, the crash loses nothing durable, and the resumed run
+    # must do EXACTLY epochs 3 and 4 — total trained epochs stays 4.
+    model = FakeHostModel(crash_on_call=3)
+    sup = TrainingSupervisor(model, str(tmp_path / "ck"),
+                             checkpoint_frequency=1, max_restarts=2)
+    sup.fit(rdd=None, epochs=4)
+    assert sup.restarts == 1
+    assert _events(sup) == ["start", "crash", "resume", "complete"]
+    assert model.master_network._w[0][0] == 4.0      # not 5, not 3
+    assert model.fit_calls == 5                      # 4 productive + 1 crash
+    _, meta, _ = load_checkpoint(str(tmp_path / "ck"))
+    assert meta["epoch"] == 4
+
+
+def test_budget_exhausted_aborts_with_cause(tmp_path):
+    sup = TrainingSupervisor(AlwaysCrashModel(), str(tmp_path / "ck"),
+                             max_restarts=2)
+    with pytest.raises(SupervisorAborted) as exc:
+        sup.fit(rdd=None, epochs=1)
+    assert sup.restarts == 2
+    assert isinstance(exc.value.__cause__, RuntimeError)
+    assert _events(sup).count("crash") == 2          # budget, then abort
+
+
+def test_should_restart_filter_aborts_immediately(tmp_path):
+    sup = TrainingSupervisor(
+        AlwaysCrashModel(), str(tmp_path / "ck"), max_restarts=5,
+        should_restart=lambda e: not isinstance(e, RuntimeError))
+    with pytest.raises(SupervisorAborted):
+        sup.fit(rdd=None, epochs=1)
+    assert sup.restarts == 0                         # never retried
+
+
+def test_restart_backoff_uses_policy(tmp_path):
+    slept = []
+    sup = TrainingSupervisor(
+        FakeHostModel(crash_on_call=1), str(tmp_path / "ck"),
+        max_restarts=1,
+        restart_policy=RetryPolicy(base_delay_s=0.25, jitter=0.0,
+                                   sleep=slept.append))
+    sup.fit(rdd=None, epochs=1)
+    assert slept == [0.25]
+
+
+def test_partial_checkpoint_is_not_resumed(tmp_path):
+    # A torn checkpoint (weights.npz missing) must read as "no checkpoint":
+    # the supervisor starts fresh instead of dying in load_checkpoint.
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    (ck / "meta.json").write_text(json.dumps({"epoch": 99}))
+    model = FakeHostModel()
+    sup = TrainingSupervisor(model, str(ck), checkpoint_frequency=1)
+    sup.fit(rdd=None, epochs=2)
+    assert _events(sup)[0] == "start"                # not "resume"
+    assert model.master_network._w[0][0] == 2.0
+
+
+def test_events_reach_callback(tmp_path):
+    seen = []
+    sup = TrainingSupervisor(FakeHostModel(crash_on_call=2),
+                             str(tmp_path / "ck"), checkpoint_frequency=1,
+                             max_restarts=1, on_event=seen.append)
+    sup.fit(rdd=None, epochs=2)
+    assert [e.kind for e in seen] == ["start", "crash", "resume", "complete"]
+    assert "injected fit crash" in [e for e in seen if e.kind == "crash"][0].detail

@@ -178,17 +178,19 @@ def test_sampled_stream_independent_of_cobatching():
     r1 = solo.submit(prompt, 10, temperature=0.8, seed=42)
     solo.drain(max_steps=200)
 
+    solo_tokens = solo.result(r1).tokens  # result() pops: read once
+
     busy = ServingEngine(model, params, n_slots=4)
     for p, m in others:
         busy.submit(p, m, temperature=1.3, seed=9)
     r2 = busy.submit(prompt, 10, temperature=0.8, seed=42)
     fin = busy.drain(max_steps=500)
-    assert solo.result(r1).tokens == fin[r2].tokens
+    assert solo_tokens == fin[r2].tokens
 
     reseed = ServingEngine(model, params, n_slots=2)
     r3 = reseed.submit(prompt, 10, temperature=0.8, seed=43)
     reseed.drain(max_steps=200)
-    assert reseed.result(r3).tokens != solo.result(r1).tokens
+    assert reseed.result(r3).tokens != solo_tokens
 
 
 def test_timing_with_fake_clock():
@@ -227,3 +229,97 @@ def test_sharded_engine_matches_local_greedy():
                                         max_new))[0, len(prompt):]
         np.testing.assert_array_equal(np.asarray(fin[rid].tokens), ref,
                                       err_msg=rid)
+
+
+def test_result_pop_on_read_and_peek():
+    """result() is pop-on-read — the retention contract — with pop=False
+    as the explicit peek."""
+    model = _model()
+    eng = ServingEngine(model, _params(model), n_slots=1)
+    rid = eng.submit(np.zeros(3, np.int32), 2)
+    eng.drain(max_steps=100)
+    assert eng.result(rid, pop=False).finish_reason == "length"  # peek
+    assert eng.result(rid, pop=False) is not None                # still there
+    assert eng.result(rid).finish_reason == "length"             # pop
+    assert eng.result(rid) is None                               # gone
+    # a popped id is reusable, like a finished-and-evicted one
+    assert eng.submit(np.zeros(3, np.int32), 2, request_id=rid) == rid
+
+
+def test_finished_retention_is_bounded():
+    """Unread results must not accumulate forever: past max_finished the
+    OLDEST records are evicted (and counted), the newest retained."""
+    model = _model()
+    eng = ServingEngine(model, _params(model), n_slots=1, max_finished=2)
+    rids = [eng.submit(np.zeros(3, np.int32), 2) for _ in range(5)]
+    eng.drain(max_steps=500)
+    assert [eng.result(r, pop=False) is not None for r in rids] == \
+        [False, False, False, True, True]
+    assert eng.snapshot()["counters"]["results_evicted"] == 3
+    with pytest.raises(ValueError):
+        ServingEngine(model, _params(model), n_slots=1, max_finished=0)
+
+
+def test_cancel_active_frees_slot_and_keeps_partial_tokens():
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(12)
+    p = rng.integers(0, V, size=(4,)).astype(np.int32)
+    eng = ServingEngine(model, params, n_slots=1)
+    rid = eng.submit(p, 10)
+    eng.step()                               # prefill: 1 token out
+    eng.step()                               # decode: 2nd token
+    assert eng.kv.active_slots == 1
+    assert eng.cancel(rid)
+    assert eng.kv.active_slots == 0          # O(1) slot reclaim
+    fin = eng.result(rid)
+    assert fin.finish_reason == "cancelled"
+    assert len(fin.tokens) == 2              # partials preserved
+    assert eng.cancel(rid) is False          # not live any more
+    assert eng.cancel("never-existed") is False
+    assert eng.snapshot()["counters"]["cancelled"] == {"cancelled": 1}
+    # the freed slot is immediately reusable
+    rid2 = eng.submit(p, 3)
+    eng.drain(max_steps=100)
+    assert eng.result(rid2).finish_reason == "length"
+
+
+def test_cancel_queued_never_occupies_slot():
+    """Cancelling a queued request tombstones it in O(1): it never
+    prefills, the queue gauge drops, and the rest drain normally."""
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(13)
+    p = rng.integers(0, V, size=(4,)).astype(np.int32)
+    eng = ServingEngine(model, params, n_slots=1, max_queue=8)
+    busy = eng.submit(p, 4)
+    eng.step()                               # busy takes the only slot
+    doomed = eng.submit(p, 4)
+    assert eng.scheduler.queue_depth == 1
+    assert eng.cancel(doomed)
+    assert eng.scheduler.queue_depth == 0
+    fin = eng.drain(max_steps=200)
+    assert eng.result(doomed).tokens == []   # never ran
+    assert fin[busy].finish_reason == "length"
+    assert eng.snapshot()["engine"]["prefills"] == 1
+
+
+def test_deadline_reaps_queued_request():
+    """A request that times out while still QUEUED is reaped with zero
+    tokens and never admitted — the slot goes to work that can still meet
+    its deadline."""
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(14)
+    p = rng.integers(0, V, size=(4,)).astype(np.int32)
+    eng = ServingEngine(model, params, n_slots=1, clock=FakeClock())
+    busy = eng.submit(p, 6)
+    doomed = eng.submit(p, 6, deadline_s=2.0)   # FakeClock: +1s per call
+    fin = eng.drain(max_steps=200)
+    assert fin[doomed].finish_reason == "deadline"
+    assert fin[doomed].tokens == []
+    assert fin[busy].finish_reason == "length"
+    assert eng.snapshot()["counters"]["cancelled"] == {"deadline": 1}
+    with pytest.raises(AdmissionError) as ei:
+        eng.submit(p, 2, deadline_s=0.0)
+    assert ei.value.reason == "bad_request"

@@ -30,6 +30,10 @@ def test_bench_emits_json_line():
         "BENCH_SERVE_SLOTS": "4",
         "BENCH_SERVE_PROMPT": "8",
         "BENCH_SERVE_NEW": "8",
+        # tiny recovery geometry: checkpoint + crash-resume must land too
+        "BENCH_REC_SAMPLES": "1024",
+        "BENCH_REC_EPOCHS": "2",
+        "BENCH_REC_WORKERS": "2",
     })
     proc = subprocess.run(
         [sys.executable, os.path.join(_REPO, "bench.py")],
@@ -50,3 +54,10 @@ def test_bench_emits_json_line():
     assert serving["ttft_p95_ms"] >= serving["ttft_p50_ms"] >= 0
     assert 0 < serving["batch_occupancy"] <= 1
     assert serving["concurrency"] == 4
+    # so is the recovery phase: checkpointing tax + one crash-resume cycle
+    recovery = result["recovery"]
+    assert recovery["plain_fit_s"] > 0
+    assert recovery["checkpointed_fit_s"] > 0
+    assert recovery["crash_resume_fit_s"] > 0
+    assert recovery["epochs"] == 2
+    assert recovery["checkpoint_frequency"] == 1

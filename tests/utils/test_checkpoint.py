@@ -55,6 +55,36 @@ class TestShardedPytree:
         np.testing.assert_array_equal(np.asarray(host["w"]),
                                       np.asarray(tree["w"]))
 
+    def test_restore_onto_different_mesh_shape(self, tmp_path):
+        """Resume onto a DIFFERENT mesh geometry: saved from a (4,2) mesh,
+        restored into shardings of a (2,4) mesh over the same 8 devices —
+        the elastic-restart case (job relaunched with a different
+        data/model split). Tensorstore serves whatever slices the new
+        sharding asks for; values must be exact."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from elephas_tpu.utils import load_sharded_pytree, \
+            save_sharded_pytree
+
+        _, tree = self._mesh_tree()          # saved over a (4, 2) mesh
+        save_sharded_pytree(str(tmp_path / "ck"), tree)
+        remesh = Mesh(np.array(jax.devices()).reshape(2, 4),
+                      ("data", "model"))
+        tmpl = {"w": jax.device_put(jnp.zeros((8, 8)),
+                                    NamedSharding(remesh,
+                                                  P("data", "model"))),
+                "nest": {"r": jax.device_put(jnp.zeros((3,)),
+                                             NamedSharding(remesh, P()))}}
+        restored = load_sharded_pytree(str(tmp_path / "ck"), template=tmpl)
+        assert restored["w"].sharding == tmpl["w"].sharding
+        assert restored["w"].sharding.mesh.devices.shape == (2, 4)
+        np.testing.assert_array_equal(np.asarray(restored["w"]),
+                                      np.asarray(tree["w"]))
+        np.testing.assert_array_equal(np.asarray(restored["nest"]["r"]),
+                                      np.asarray(tree["nest"]["r"]))
+
     def test_restore_into_different_sharding(self, tmp_path):
         import jax
         import jax.numpy as jnp
@@ -75,6 +105,47 @@ class TestShardedPytree:
         assert restored["w"].sharding == tmpl["w"].sharding
         np.testing.assert_array_equal(np.asarray(restored["w"]),
                                       np.asarray(tree["w"]))
+
+    def test_has_checkpoint_rejects_partial_directory(self, tmp_path):
+        """A crash can die between the weights write and the meta commit
+        point, or mid-``json.dump``; an auto-resume probe must classify
+        every such partial directory as 'no checkpoint'."""
+        import json
+
+        from elephas_tpu.utils.checkpoint import (has_checkpoint,
+                                                  save_checkpoint)
+
+        assert not has_checkpoint(str(tmp_path / "missing"))
+
+        # weights landed, crash before meta.json (the commit point)
+        weights_only = tmp_path / "weights_only"
+        weights_only.mkdir()
+        from elephas_tpu.utils.serialization import save_weights_npz
+
+        save_weights_npz(str(weights_only / "weights.npz"),
+                         [np.ones((2, 2), np.float32)])
+        assert not has_checkpoint(str(weights_only))
+
+        # meta.json landed but truncated mid-json.dump
+        truncated = tmp_path / "truncated"
+        truncated.mkdir()
+        save_weights_npz(str(truncated / "weights.npz"),
+                         [np.ones((2, 2), np.float32)])
+        (truncated / "meta.json").write_text('{"epoch": ')
+        assert not has_checkpoint(str(truncated))
+
+        # meta.json parses but weights.npz is gone (partial delete /
+        # out-of-order writer)
+        meta_only = tmp_path / "meta_only"
+        meta_only.mkdir()
+        (meta_only / "meta.json").write_text(json.dumps({"epoch": 1}))
+        assert not has_checkpoint(str(meta_only))
+
+        # the real thing still passes
+        good = tmp_path / "good"
+        save_checkpoint(str(good), [np.ones((2, 2), np.float32)],
+                        {"epoch": 1})
+        assert has_checkpoint(str(good))
 
     def test_resumes_lm_trainer_bit_identically(self, tmp_path):
         import jax
