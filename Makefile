@@ -7,13 +7,18 @@
 TEST_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 KERAS_BACKEND=jax
 
-.PHONY: test test-fast bench
+.PHONY: test test-fast test-chaos bench
 
 test:
 	$(TEST_ENV) bash scripts/run_tests.sh -x -q
 
 test-fast:
 	$(TEST_ENV) bash scripts/run_tests.sh -x -q -m "not slow"
+
+# Pinned deterministic chaos scenarios only (quorum commit under dead
+# workers, straggler backup exactly-once, hot-standby PS failover).
+test-chaos:
+	ELEPHAS_TEST_GROUP=chaos $(TEST_ENV) bash scripts/run_tests.sh -x -q
 
 bench:
 	KERAS_BACKEND=jax python bench.py

@@ -536,6 +536,110 @@ def bench_recovery(reps: int):
     }
 
 
+def bench_failover(reps: int):
+    """Hot-standby parameter-server failover tax vs an unfaulted async fit.
+
+    CPU-runnable. Two timed runs of the SAME host-path asynchronous
+    training job against a live HTTP parameter server: (a) plain, and
+    (b) with a hot standby attached and the primary killed mid-run by a
+    seeded FaultPlan — clients transparently re-target the standby and
+    training completes on it. Reports the recovered throughput and the
+    wall-clock penalty of one failover (standby replication + client
+    re-targeting + staleness catch-up). Each faulted rep verifies the
+    failover actually happened and that no committed update was lost
+    (standby version >= primary version after replication drains). Skip
+    with BENCH_FAILOVER=0; size via BENCH_FO_{SAMPLES,EPOCHS,BATCH,WORKERS}.
+    """
+    import numpy as np
+
+    if os.environ.get("BENCH_FAILOVER", "1") == "0":
+        log("failover bench: skipped (BENCH_FAILOVER=0)")
+        return None
+
+    from elephas_tpu import SparkModel
+    from elephas_tpu.data import SparkContext
+    from elephas_tpu.resilience import FaultPlan, HeartbeatRegistry
+    from elephas_tpu.utils import to_simple_rdd
+
+    def knob(name, default):
+        return int(os.environ.get(f"BENCH_FO_{name.upper()}", default))
+
+    n = knob("samples", 4096)
+    epochs = knob("epochs", 2)
+    batch = knob("batch", 128)
+    workers = knob("workers", 2)
+    d, c = 64, 10
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(n, d)).astype("float32")
+    w = rng.normal(size=(d, c))
+    y = np.eye(c, dtype="float32")[(x @ w).argmax(1)]
+    sc = SparkContext(master=f"local[{workers}]", appName="bench-failover")
+    rdd = to_simple_rdd(sc, x, y, num_slices=workers)
+    fit_kw = dict(epochs=epochs, batch_size=batch, verbose=0,
+                  validation_split=0.0)
+    log(f"failover bench: {n} samples x {epochs} epochs on {workers} "
+        f"async workers (http PS)")
+
+    def run(kill: bool) -> float:
+        # fresh model/plan/registry per rep: crash sites fire once per plan
+        plan = registry = None
+        if kill:
+            # the kill lands mid-training: after each worker registered and
+            # pushed at least once, before the run is over
+            plan = FaultPlan(seed=1, crash_sites={
+                "kill-primary": workers * 2 + 1})
+            registry = HeartbeatRegistry(lease_s=300.0)
+        sm = SparkModel(
+            make_model(d, c), mode="asynchronous", num_workers=workers,
+            comm="host", parameter_server_mode="http", port=0,
+            fault_plan=plan, membership=registry, hot_standby=kill,
+        )
+        sm.fit(rdd, **fit_kw)    # warmup/compile happens inside; timed whole
+        if kill:
+            if "kill-primary" not in plan.fired:
+                raise RuntimeError(
+                    "failover bench: the injected PS kill never fired "
+                    "(too few requests? lower the kill index)")
+            snap = sm.membership_snapshot()
+            if snap["counters"].get("failovers", 0) < 1:
+                raise RuntimeError("failover bench: no failover observed")
+            ps = snap["parameter_servers"]
+            if ps["standby"]["version"] < ps["primary"]["version"]:
+                raise RuntimeError(
+                    "failover bench: standby lost committed updates "
+                    f"({ps['standby']['version']} < "
+                    f"{ps['primary']['version']})")
+        return 0.0
+
+    def best(label, kill):
+        t = float("inf")
+        for rep in range(max(1, reps)):
+            t0 = time.perf_counter()
+            run(kill)
+            dt = time.perf_counter() - t0
+            log(f"failover rep {rep}: {label} {dt:.2f}s")
+            t = min(t, dt)
+        return t
+
+    run(kill=False)              # untimed warmup: absorb compile cost
+    t_plain = best("plain", kill=False)
+    t_failover = best("primary-killed", kill=True)
+    penalty = t_failover - t_plain
+    recovered_sps = n * epochs / t_failover
+    log(f"failover bench: plain {t_plain:.2f}s, primary-killed "
+        f"{t_failover:.2f}s (+{penalty:.2f}s for one failover), "
+        f"recovered {recovered_sps:,.0f} samples/sec")
+    return {
+        "plain_fit_s": round(t_plain, 3),
+        "failover_fit_s": round(t_failover, 3),
+        "failover_penalty_s": round(penalty, 3),
+        "recovered_samples_per_sec": round(recovered_sps, 1),
+        "epochs": epochs,
+        "config": f"{n}x{d}-e{epochs}-w{workers}",
+    }
+
+
 def make_model(input_dim, nb_classes):
     import keras
 
@@ -701,6 +805,16 @@ def main():
         recovery = None
     if recovery is not None:
         result["recovery"] = recovery
+        print(json.dumps(result), flush=True)
+
+    # -- failover phase: hot-standby PS kill tax (CPU-runnable) -----------
+    try:
+        failover = bench_failover(reps)
+    except Exception as e:
+        log(f"failover bench failed: {type(e).__name__}: {e}")
+        failover = None
+    if failover is not None:
+        result["failover"] = failover
         print(json.dumps(result), flush=True)
 
     # -- LM phase: FLOPs-accounted tokens/sec + MFU on the same chip ------

@@ -224,8 +224,17 @@ class CompiledTrainer:
             batch_size: int, validation_split: float = 0.0,
             seed: int = 0, verbose: int = 0, opt_state: Any = None,
             keep_opt_state: bool = False, worker_state: Any = None,
-            keep_worker_state: bool = False, epoch_offset: int = 0) -> FitResult:
+            keep_worker_state: bool = False, epoch_offset: int = 0,
+            worker_valid: Optional[Sequence[float]] = None) -> FitResult:
         """Train over per-worker data ``blocks`` ``[(x_w, y_w), ...]``.
+
+        ``worker_valid`` (one float per block, 1.0 = live, 0.0 = excluded)
+        overrides the merge validity mask — DeepSpark-style partial
+        aggregation: an excluded worker's shard still occupies its mesh slot
+        (geometry, and therefore the compiled executable, is unchanged) but
+        contributes nothing to any merge denominator or batch-delta sum. The
+        elastic layer (``SparkModel(membership=...)``) uses this to commit
+        rounds without expired members instead of blocking on them.
 
         Returns merged weights in ``get_weights()`` order plus per-epoch
         history (``loss``[, ``accuracy``, ``val_loss``, ``val_accuracy``]).
@@ -298,7 +307,20 @@ class CompiledTrainer:
             sv = stack_pad(svs, np.zeros_like(svs[0]))
         else:
             xv = yv = sv = np.zeros((Wp, 1), np.float32)
-        wvalid = np.array([1.0] * W + [0.0] * (Wp - W), np.float32)
+        if worker_valid is None:
+            wvalid = np.array([1.0] * W + [0.0] * (Wp - W), np.float32)
+        else:
+            if len(worker_valid) != W:
+                raise ValueError(
+                    f"worker_valid has {len(worker_valid)} entries for "
+                    f"{W} worker blocks"
+                )
+            wvalid = np.array(
+                [float(v) for v in worker_valid] + [0.0] * (Wp - W),
+                np.float32,
+            )
+            if wvalid.sum() <= 0.0:
+                raise ValueError("worker_valid excludes every worker")
         keys = jax.random.split(jax.random.PRNGKey(seed), Wp)
 
         # Device staging cache: same block arrays + geometry → reuse the
@@ -308,6 +330,7 @@ class CompiledTrainer:
         stage_key = (
             tuple((id(bx), id(by)) for bx, by in blocks),
             validation_split, N, Nv, Wp,
+            None if worker_valid is None else tuple(float(v) for v in worker_valid),
         )
         staged = getattr(self, "_staged", None)
         if staged is not None and staged[0] == stage_key:

@@ -36,11 +36,22 @@ class BaseParameterClient:
             return SocketClient(port=port, host=host, timeout=timeout)
         raise ValueError(f"Unknown parameter server mode: {client_mode}")
 
+    #: highest server weight-version this client has observed (piggybacked
+    #: on pulls where the transport allows; -1 = none yet / unsupported).
+    #: FailoverClient uses it to bound staleness when re-targeting a standby.
+    last_seen_version: int = -1
+
     def get_parameters(self) -> List[np.ndarray]:
         raise NotImplementedError
 
     def update_parameters(self, delta: List[np.ndarray]) -> None:
         raise NotImplementedError
+
+    def get_version(self) -> int:
+        """The server's monotonic weight version (+1 per applied delta).
+        Returns -1 when the backend doesn't expose one — callers must treat
+        that as "cannot bound staleness", not as version zero."""
+        return -1
 
     def register_attempt(self, task_id: str, attempt: int) -> bool:
         """Announce a task attempt to the server (exactly-once retry support).
@@ -55,7 +66,11 @@ class BaseParameterClient:
         return False
 
     def update_parameters_tagged(self, task_id: str,
-                                 delta: List[np.ndarray]) -> None:
+                                 delta: List[np.ndarray],
+                                 attempt: Optional[int] = None) -> None:
+        """Tagged push; ``attempt`` additionally lets the server fence
+        pushes from superseded (zombie) attempts — see
+        ``BaseParameterServer.apply_delta``."""
         self.update_parameters(delta)
 
     def commit_attempt(self, task_id: str) -> None:
@@ -75,12 +90,29 @@ class HttpClient(BaseParameterClient):
         else:
             self.master_url = f"{host}:{port}"
         self.timeout = float(timeout)
+        self.last_seen_version = -1
 
     def get_parameters(self) -> List[np.ndarray]:
         with urllib.request.urlopen(
             f"http://{self.master_url}/parameters", timeout=self.timeout
         ) as resp:
+            version = resp.headers.get("X-Elephas-Version")
+            if version is not None:
+                self.last_seen_version = int(version)
             return pickle.loads(resp.read())
+
+    def get_version(self) -> int:
+        try:
+            with urllib.request.urlopen(
+                f"http://{self.master_url}/version", timeout=self.timeout
+            ) as resp:
+                version = int(resp.read().decode().strip())
+        except urllib.error.HTTPError as err:
+            if err.code == 404:
+                return -1  # pre-versioning server: staleness unbounded
+            raise
+        self.last_seen_version = max(self.last_seen_version, version)
+        return version
 
     def update_parameters(self, delta: List[np.ndarray],
                           _extra_headers: Optional[dict] = None) -> None:
@@ -120,8 +152,12 @@ class HttpClient(BaseParameterClient):
             raise
 
     def update_parameters_tagged(self, task_id: str,
-                                 delta: List[np.ndarray]) -> None:
-        self.update_parameters(delta, _extra_headers={"X-Elephas-Task": task_id})
+                                 delta: List[np.ndarray],
+                                 attempt: Optional[int] = None) -> None:
+        headers = {"X-Elephas-Task": task_id}
+        if attempt is not None:
+            headers["X-Elephas-Attempt"] = str(int(attempt))
+        self.update_parameters(delta, _extra_headers=headers)
 
     def commit_attempt(self, task_id: str) -> None:
         req = urllib.request.Request(
@@ -139,6 +175,15 @@ class SocketClient(BaseParameterClient):
 
     Thread-safe: pull/push pairs are serialized per client with a lock so the
     opcode stream cannot interleave across threads sharing a client.
+
+    Broken-pipe recovery: a persistent socket goes stale whenever the peer
+    resets (server restart, failover, idle LB reap). Every operation retries
+    ONCE on a fresh connection after a ``ConnectionError``/``OSError`` —
+    without this, the first op after a reset failed the whole worker task
+    even though the server was back. ``socket.timeout`` is never blindly
+    retried: a timed-out push may have been applied, and re-sending it is
+    exactly the double-apply the attempt machinery exists to prevent (the
+    retry decision belongs to the policy layer, which knows the semantics).
     """
 
     def __init__(self, port: int = 4000, host: Optional[str] = None,
@@ -150,6 +195,7 @@ class SocketClient(BaseParameterClient):
         self.timeout = float(timeout)
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        self.last_seen_version = -1
 
     def _ensure(self) -> socket.socket:
         if self._sock is None:
@@ -158,57 +204,121 @@ class SocketClient(BaseParameterClient):
             )
         return self._sock
 
+    def _reset(self) -> None:
+        # caller holds the lock
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _roundtrip(self, op):
+        """Run ``op(sock)`` with one reconnect on a stale connection.
+        Caller holds the lock."""
+        try:
+            return op(self._ensure())
+        except socket.timeout:
+            raise
+        except (ConnectionError, OSError):
+            self._reset()
+            try:
+                return op(self._ensure())
+            except socket.timeout:
+                raise
+            except (ConnectionError, OSError):
+                # the fresh connection failed too: the server is genuinely
+                # gone — drop the socket so a future call reconnects cleanly
+                self._reset()
+                raise
+
     def get_parameters(self) -> List[np.ndarray]:
-        with self._lock:
-            sock = self._ensure()
+        def op(sock):
             sock.sendall(b"g")
             return socket_utils.receive(sock)
 
-    def update_parameters(self, delta: List[np.ndarray]) -> None:
         with self._lock:
-            sock = self._ensure()
+            return self._roundtrip(op)
+
+    def get_version(self) -> int:
+        def op(sock):
+            sock.sendall(b"v")
+            return int(socket_utils.receive(sock))
+
+        with self._lock:
+            version = self._roundtrip(op)
+            self.last_seen_version = max(self.last_seen_version, version)
+            return version
+
+    def update_parameters(self, delta: List[np.ndarray]) -> None:
+        def op(sock):
             sock.sendall(b"u")
             socket_utils.send(sock, delta)
 
+        with self._lock:
+            self._roundtrip(op)
+
     def register_attempt(self, task_id: str, attempt: int) -> bool:
         with self._lock:
-            sock = self._ensure()
-            try:
-                sock.sendall(b"r")
-                socket_utils.send(sock, (task_id, int(attempt)))
-                ack = sock.recv(1)
-            except socket.timeout:
-                # Slow server ≠ missing attempt API: it may have registered
-                # the attempt, so degrading to untagged pushes here would
-                # reopen the double-apply hole. Let task retry handle it.
-                raise
-            except ConnectionError:
-                # Server dropped the connection on the unknown opcode — the
-                # reference protocol's reaction. Treat as "no attempt API".
-                ack = b""
-            if ack != b"k":
-                # No-attempt-API server closed the connection (clean EOF or
-                # reset) — drop the dead socket so later plain pulls/pushes
-                # reconnect, and degrade to untagged pushes.
+            ack = b""
+            for retry in (False, True):
+                sock = self._ensure()
                 try:
-                    sock.close()
-                finally:
-                    self._sock = None
+                    sock.sendall(b"r")
+                    socket_utils.send(sock, (task_id, int(attempt)))
+                    ack = sock.recv(1)
+                except socket.timeout:
+                    # Slow server ≠ missing attempt API: it may have
+                    # registered the attempt, so degrading to untagged
+                    # pushes here would reopen the double-apply hole. Let
+                    # task retry handle it.
+                    raise
+                except (ConnectionError, OSError):
+                    # A stale persistent socket dies on the FIRST write after
+                    # a peer reset: reconnect once and re-ask. Registration
+                    # is idempotent server-side, so the re-ask is safe.
+                    self._reset()
+                    if retry:
+                        raise
+                    continue
+                break
+            if ack == b"x":
+                # The server answered "administratively down" (injected kill
+                # / draining for failover) — unlike a legacy server's silent
+                # close, this is an outage, not a missing attempt API.
+                self._reset()
+                raise ConnectionError(
+                    "parameter server reports itself down"
+                )
+            if ack != b"k":
+                # No-attempt-API server closed the connection (clean EOF) —
+                # drop the dead socket so later plain pulls/pushes
+                # reconnect, and degrade to untagged pushes.
+                self._reset()
                 return False
         return True
 
     def update_parameters_tagged(self, task_id: str,
-                                 delta: List[np.ndarray]) -> None:
+                                 delta: List[np.ndarray],
+                                 attempt: Optional[int] = None) -> None:
+        def op(sock):
+            if attempt is None:
+                sock.sendall(b"t")
+                socket_utils.send(sock, (task_id, delta))
+            else:
+                sock.sendall(b"a")
+                socket_utils.send(sock, (task_id, int(attempt), delta))
+
         with self._lock:
-            sock = self._ensure()
-            sock.sendall(b"t")
-            socket_utils.send(sock, (task_id, delta))
+            self._roundtrip(op)
 
     def commit_attempt(self, task_id: str) -> None:
-        with self._lock:
-            sock = self._ensure()
+        def op(sock):
             sock.sendall(b"c")
             socket_utils.send(sock, task_id)
+
+        with self._lock:
+            self._roundtrip(op)
 
     def close(self) -> None:
         with self._lock:

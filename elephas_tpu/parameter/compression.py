@@ -172,8 +172,17 @@ class CompressingClient:
             self._tagged = True
         return ok
 
-    def update_parameters_tagged(self, task_id, delta):
-        self._inner.update_parameters_tagged(task_id, self._codec.encode(delta))
+    def update_parameters_tagged(self, task_id, delta, attempt=None):
+        encoded = self._codec.encode(delta)
+        if attempt is None:
+            self._inner.update_parameters_tagged(task_id, encoded)
+        else:
+            self._inner.update_parameters_tagged(
+                task_id, encoded, attempt=attempt
+            )
+
+    def get_version(self):
+        return self._inner.get_version()
 
     def commit_attempt(self, task_id):
         # Flush BEFORE committing, tagged with the task: if the flush (or

@@ -310,7 +310,12 @@ class NativeClient(BaseParameterClient):
         return True
 
     def update_parameters_tagged(self, task_id: str,
-                                 delta: List[np.ndarray]) -> None:
+                                 delta: List[np.ndarray],
+                                 attempt=None) -> None:
+        # ``attempt`` is accepted for wrapper-stack compatibility but not
+        # carried on the native binary protocol: the native server fences by
+        # rollback-on-register only (no per-push zombie fencing). get_version
+        # likewise stays at the base -1 ("cannot bound staleness").
         if self.codec is not None:
             self._push([b"W"] + self._task_id_frame(task_id),
                        self._compressed_payload(delta))

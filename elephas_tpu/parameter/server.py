@@ -44,7 +44,8 @@ class BaseParameterServer:
     """
 
     def __init__(self, weights: List[np.ndarray], mode: str = "asynchronous",
-                 port: int = 4000, fault_plan: Any = None, **_kwargs):
+                 port: int = 4000, fault_plan: Any = None,
+                 name: str = "primary", **_kwargs):
         self.weights = [np.array(w) for w in weights]
         self.mode = mode
         self.port = int(port)
@@ -52,13 +53,103 @@ class BaseParameterServer:
         # never imports the resilience package): lets chaos tests lose
         # deltas server-side — the push "arrived" but its application is
         # dropped — and stall reads, independent of any client wrapper.
+        # crash_sites={"kill-<name>": k} kills THIS server at its k-th
+        # request: every subsequent operation raises ConnectionError
+        # (fail-stop for new traffic; already-accepted work, including the
+        # replication queue, drains normally).
         self.fault_plan = fault_plan
+        self.name = str(name)
         self.lock = threading.Lock()
         self._running = False
+        self._dead = False
         # task_id -> {"attempt": int, "delta": accumulated delta or None}.
         # Supports exactly-once retry semantics: see register_attempt.
         # Insertion-ordered; bounded by _MAX_ATTEMPT_RECORDS (below).
         self._attempts: dict = {}
+        # task_id -> highest attempt ever registered. A push tagged with a
+        # LOWER attempt is a zombie (its task was superseded by a backup or
+        # retry): rejected outright, even after the winner committed and
+        # dropped its accumulator — the fence is what makes first-finish-wins
+        # exactly-once against the straggler that eventually wakes up.
+        # Bounded like _attempts; kept on commit (that is the point).
+        self._fence: dict = {}
+        # Monotonic weight version: +1 per applied delta. Lets clients bound
+        # staleness across failover (FailoverClient compares counters) and
+        # makes "no committed update lost" checkable: after replication
+        # drains, standby.version >= primary.version.
+        self.version = 0
+        self.applied_tagged: dict = {}   # task_id -> applied tagged deltas
+        self.rejected_stale = 0          # pushes refused by attempt fence
+        # Hot-standby replication: an ordered queue of (op, args) applied to
+        # the standby by a daemon thread — asynchronous, so the primary's
+        # request path never blocks on the standby.
+        self._standby = None
+        self._repl_queue: Any = None
+        self._repl_thread: Any = None
+        self.replication_errors = 0
+
+    # -- liveness (injected kill) ----------------------------------------
+    def _check_alive(self) -> None:
+        """Raise ConnectionError if this server has been killed (or dies
+        right now: its fault plan fires ``kill-<name>`` at this request)."""
+        if self._dead:
+            raise ConnectionError(
+                f"parameter server {self.name!r} is down (injected kill)"
+            )
+        if self.fault_plan is not None:
+            try:
+                self.fault_plan.tick(f"kill-{self.name}")
+            except Exception as err:
+                self._dead = True
+                raise ConnectionError(
+                    f"parameter server {self.name!r} killed (injected)"
+                ) from err
+
+    # -- hot-standby replication -----------------------------------------
+    def attach_standby(self, standby: "BaseParameterServer") -> None:
+        """Stream every applied delta / register / commit to ``standby``,
+        in order, asynchronously. The standby applies the same operations
+        through its own ``apply_delta``/``register_attempt``/
+        ``commit_attempt``, so its version counter advances comparably and
+        its attempt table mirrors the primary's."""
+        import queue as queue_mod
+
+        self._standby = standby
+        self._repl_queue = queue_mod.Queue()
+        self._repl_thread = threading.Thread(
+            target=self._replication_loop, daemon=True,
+            name=f"ps-replication-{self.name}",
+        )
+        self._repl_thread.start()
+
+    def _replication_loop(self) -> None:
+        while True:
+            item = self._repl_queue.get()
+            try:
+                if item is None:
+                    return
+                op, args = item
+                try:
+                    if op == "delta":
+                        self._standby.apply_delta(*args)
+                    elif op == "register":
+                        self._standby.register_attempt(*args)
+                    elif op == "commit":
+                        self._standby.commit_attempt(*args)
+                except Exception:
+                    # A sick standby must not take the primary down with it.
+                    self.replication_errors += 1
+            finally:
+                self._repl_queue.task_done()
+
+    def _replicate(self, op: str, *args: Any) -> None:
+        if self._repl_queue is not None:
+            self._repl_queue.put((op, args))
+
+    def flush_replication(self) -> None:
+        """Block until every queued replication op has been applied."""
+        if self._repl_queue is not None:
+            self._repl_queue.join()
 
     # Abandoned-record bound: task ids are stage-scoped (worker.py), so on a
     # LONG-LIVED server every job that dies with retries exhausted leaves an
@@ -71,30 +162,47 @@ class BaseParameterServer:
 
     # -- weight ops ------------------------------------------------------
     def apply_delta(self, delta: List[np.ndarray],
-                    task_id: Optional[str] = None) -> None:
+                    task_id: Optional[str] = None,
+                    attempt: Optional[int] = None) -> None:
         from .compression import maybe_decode
 
+        self._check_alive()
         if self.fault_plan is not None and self.fault_plan.drop_server_push():
             return  # injected server-side loss: the delta is never applied
         delta = maybe_decode(delta)  # transparent: plain lists pass through
 
-        def _apply():
+        def _apply() -> bool:
+            if (task_id is not None and attempt is not None
+                    and int(attempt) < self._fence.get(task_id, 0)):
+                # Zombie push: a newer attempt of this task registered (a
+                # backup won, or a retry superseded it). Applying it would
+                # double-count work the live attempt redoes — refuse.
+                self.rejected_stale += 1
+                return False
             self.weights = subtract_params_np(self.weights, delta)
-            if task_id is not None and task_id in self._attempts:
-                acc = self._attempts[task_id]["delta"]
-                self._attempts[task_id]["delta"] = (
-                    [np.array(d) for d in delta] if acc is None
-                    else [a + d for a, d in zip(acc, delta)]
+            self.version += 1
+            if task_id is not None:
+                self.applied_tagged[task_id] = (
+                    self.applied_tagged.get(task_id, 0) + 1
                 )
+                if task_id in self._attempts:
+                    acc = self._attempts[task_id]["delta"]
+                    self._attempts[task_id]["delta"] = (
+                        [np.array(d) for d in delta] if acc is None
+                        else [a + d for a, d in zip(acc, delta)]
+                    )
+            return True
 
         if self.mode == "hogwild":
             # Lock-free by design: concurrent updates may interleave
             # per-array — HOGWILD! semantics. (Attempt accumulation shares
             # that best-effort contract.)
-            _apply()
+            applied = _apply()
         else:
             with self.lock:
-                _apply()
+                applied = _apply()
+        if applied:
+            self._replicate("delta", delta, task_id, attempt)
 
     def register_attempt(self, task_id: str, attempt: int) -> None:
         """Announce that ``(task_id, attempt)`` is starting.
@@ -120,11 +228,25 @@ class BaseParameterServer:
         exactly like every other hogwild write. That is the mode's contract:
         it trades consistency for lock-free throughput.
         """
+        self._check_alive()
         with self.lock:
             prev = self._attempts.get(task_id)
             if prev is None:
                 while len(self._attempts) >= self._MAX_ATTEMPT_RECORDS:
-                    self._attempts.pop(next(iter(self._attempts)))
+                    evicted_id = next(iter(self._attempts))
+                    evicted = self._attempts.pop(evicted_id)
+                    if evicted["delta"] is not None:
+                        # The evicted task is abandoned as far as we know —
+                        # roll its uncommitted contribution back, exactly as
+                        # a re-register would. If it IS still alive and
+                        # later retries, the retry re-pushes from scratch
+                        # and nothing double-applies; if it commits, it
+                        # under-counts one slow worker's delta (async SGD
+                        # absorbs that; double-apply it cannot absorb).
+                        self.weights = [
+                            w + d
+                            for w, d in zip(self.weights, evicted["delta"])
+                        ]
                 self._attempts[task_id] = {"attempt": int(attempt), "delta": None}
             elif int(attempt) > prev["attempt"]:
                 if prev["delta"] is not None:
@@ -133,6 +255,11 @@ class BaseParameterServer:
                     ]
                 self._attempts[task_id] = {"attempt": int(attempt), "delta": None}
             # else: stale/duplicate — keep the live attempt record
+            if int(attempt) > self._fence.get(task_id, 0):
+                while len(self._fence) >= self._MAX_ATTEMPT_RECORDS:
+                    self._fence.pop(next(iter(self._fence)))
+                self._fence[task_id] = int(attempt)
+        self._replicate("register", task_id, attempt)
 
     def commit_attempt(self, task_id: str) -> None:
         """A task finished cleanly: drop its accumulator.
@@ -142,10 +269,15 @@ class BaseParameterServer:
         fit. A committed task that somehow still retries (shouldn't happen:
         the facade only retries on exception) re-registers from scratch.
         """
+        self._check_alive()
         with self.lock:
             self._attempts.pop(task_id, None)
+            # the fence survives the commit: a zombie attempt of this task
+            # waking up later must still be refused
+        self._replicate("commit", task_id)
 
     def get_weights(self) -> List[np.ndarray]:
+        self._check_alive()
         if self.fault_plan is not None:
             self.fault_plan.delay_server_pull()  # injected slow read
         return self.weights
@@ -155,6 +287,14 @@ class BaseParameterServer:
 
     def stop(self) -> None:
         raise NotImplementedError
+
+    def stop_replication(self) -> None:
+        """Drain and stop the replication stream (no-op if never attached)."""
+        if self._repl_thread is not None:
+            self._repl_queue.join()
+            self._repl_queue.put(None)
+            self._repl_thread.join(timeout=5)
+            self._repl_thread = None
 
 
 class HttpServer(BaseParameterServer):
@@ -180,46 +320,77 @@ class HttpServer(BaseParameterServer):
                     http.server.BaseHTTPRequestHandler.log_message(self, *args)
 
             def do_GET(self):
-                if self.path.rstrip("/") == "/parameters" or self.path == "/":
-                    payload = pickle.dumps(
-                        server.get_weights(), protocol=pickle.HIGHEST_PROTOCOL
-                    )
-                    self.send_response(200)
-                    self.send_header("Content-Type", "application/octet-stream")
-                    self.send_header("Content-Length", str(len(payload)))
-                    self.end_headers()
-                    self.wfile.write(payload)
-                else:
-                    self.send_error(404)
+                try:
+                    path = self.path.rstrip("/")
+                    if path == "/parameters" or self.path == "/":
+                        payload = pickle.dumps(
+                            server.get_weights(),
+                            protocol=pickle.HIGHEST_PROTOCOL,
+                        )
+                        self.send_response(200)
+                        self.send_header(
+                            "Content-Type", "application/octet-stream"
+                        )
+                        self.send_header("Content-Length", str(len(payload)))
+                        # piggyback the version so pulls track staleness for
+                        # free (FailoverClient's bound across failover)
+                        self.send_header(
+                            "X-Elephas-Version", str(server.version)
+                        )
+                        self.end_headers()
+                        self.wfile.write(payload)
+                    elif path == "/version":
+                        server._check_alive()
+                        payload = str(server.version).encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type", "text/plain")
+                        self.send_header("Content-Length", str(len(payload)))
+                        self.end_headers()
+                        self.wfile.write(payload)
+                    else:
+                        self.send_error(404)
+                except ConnectionError:
+                    # injected kill: the service is down, the process isn't —
+                    # 503 surfaces as a transient URLError client-side
+                    self.send_error(503)
 
             def do_POST(self):
-                path = self.path.rstrip("/")
-                if path == "/update":
-                    length = int(self.headers.get("Content-Length", 0))
-                    delta = pickle.loads(self.rfile.read(length))
-                    # Optional task tag (exactly-once retry support); plain
-                    # reference-shaped clients omit it and behave as before.
-                    server.apply_delta(
-                        delta, task_id=self.headers.get("X-Elephas-Task")
-                    )
-                    self._ok()
-                elif path == "/register":
-                    length = int(self.headers.get("Content-Length", 0))
-                    if length:
-                        self.rfile.read(length)
-                    server.register_attempt(
-                        self.headers.get("X-Elephas-Task", ""),
-                        int(self.headers.get("X-Elephas-Attempt", 0)),
-                    )
-                    self._ok()
-                elif path == "/commit":
-                    length = int(self.headers.get("Content-Length", 0))
-                    if length:
-                        self.rfile.read(length)
-                    server.commit_attempt(self.headers.get("X-Elephas-Task", ""))
-                    self._ok()
-                else:
-                    self.send_error(404)
+                try:
+                    path = self.path.rstrip("/")
+                    if path == "/update":
+                        length = int(self.headers.get("Content-Length", 0))
+                        delta = pickle.loads(self.rfile.read(length))
+                        # Optional task/attempt tags (exactly-once retry +
+                        # zombie fencing); plain reference-shaped clients
+                        # omit them and behave as before.
+                        attempt = self.headers.get("X-Elephas-Attempt")
+                        server.apply_delta(
+                            delta,
+                            task_id=self.headers.get("X-Elephas-Task"),
+                            attempt=None if attempt is None else int(attempt),
+                        )
+                        self._ok()
+                    elif path == "/register":
+                        length = int(self.headers.get("Content-Length", 0))
+                        if length:
+                            self.rfile.read(length)
+                        server.register_attempt(
+                            self.headers.get("X-Elephas-Task", ""),
+                            int(self.headers.get("X-Elephas-Attempt", 0)),
+                        )
+                        self._ok()
+                    elif path == "/commit":
+                        length = int(self.headers.get("Content-Length", 0))
+                        if length:
+                            self.rfile.read(length)
+                        server.commit_attempt(
+                            self.headers.get("X-Elephas-Task", "")
+                        )
+                        self._ok()
+                    else:
+                        self.send_error(404)
+                except ConnectionError:
+                    self.send_error(503)
 
             def _ok(self):
                 self.send_response(200)
@@ -241,6 +412,7 @@ class HttpServer(BaseParameterServer):
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        self.stop_replication()
         self._running = False
 
 
@@ -301,16 +473,31 @@ class SocketServer(BaseParameterServer):
                     # tagged update: (task_id, delta) — exactly-once retries
                     task_id, delta = socket_utils.receive(conn)
                     self.apply_delta(delta, task_id=task_id)
+                elif op == b"a":
+                    # attempt-tagged update: (task_id, attempt, delta) —
+                    # lets the server fence zombie attempts' pushes
+                    task_id, attempt, delta = socket_utils.receive(conn)
+                    self.apply_delta(delta, task_id=task_id, attempt=attempt)
                 elif op == b"r":
                     # register (task_id, attempt); ack so the client can
-                    # order its first pull after the rollback
+                    # order its first pull after the rollback. A dead server
+                    # acks b'x' (distinguishable from a legacy server's
+                    # silent close, which means "no attempt API").
                     task_id, attempt = socket_utils.receive(conn)
-                    self.register_attempt(task_id, attempt)
+                    try:
+                        self.register_attempt(task_id, attempt)
+                    except ConnectionError:
+                        conn.sendall(b"x")
+                        break
                     conn.sendall(b"k")
                 elif op == b"c":
                     # commit: task finished cleanly, drop its accumulator
                     task_id = socket_utils.receive(conn)
                     self.commit_attempt(task_id)
+                elif op == b"v":
+                    # monotonic weight version (staleness bound on failover)
+                    self._check_alive()
+                    socket_utils.send(conn, self.version)
                 else:
                     break
         except (ConnectionError, OSError):
@@ -326,4 +513,5 @@ class SocketServer(BaseParameterServer):
         if self._sock is not None:
             self._sock.close()
             self._sock = None
+        self.stop_replication()
         self._running = False

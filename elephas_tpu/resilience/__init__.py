@@ -42,9 +42,17 @@ from .faults import (
     InjectedWorkerCrash,
     TransientFault,
 )
+from .membership import (
+    HeartbeatRegistry,
+    MembershipEvent,
+    QuorumLostError,
+    QuorumRunner,
+    member_id_for,
+)
 from .policy import (
     CircuitBreaker,
     CircuitOpenError,
+    FailoverClient,
     ResilientClient,
     RetryExhausted,
     RetryPolicy,
@@ -55,10 +63,15 @@ from .supervisor import SupervisorAborted, SupervisorEvent, TrainingSupervisor
 __all__ = [
     "CircuitBreaker",
     "CircuitOpenError",
+    "FailoverClient",
     "FaultPlan",
     "FaultyClient",
+    "HeartbeatRegistry",
     "InjectedFault",
     "InjectedWorkerCrash",
+    "MembershipEvent",
+    "QuorumLostError",
+    "QuorumRunner",
     "ResilientClient",
     "RetryExhausted",
     "RetryPolicy",
@@ -66,4 +79,5 @@ __all__ = [
     "SupervisorEvent",
     "TrainingSupervisor",
     "default_is_transient",
+    "member_id_for",
 ]

@@ -42,7 +42,7 @@ from __future__ import annotations
 import hashlib
 import threading
 import time
-from typing import Callable, Dict, Optional, Tuple
+from typing import Callable, Dict, Iterable, Optional, Tuple
 
 from ..parameter.client import BaseParameterClient
 
@@ -88,6 +88,8 @@ class FaultPlan:
                  crash_partition: Optional[int] = None,
                  crash_after_pushes: int = 0,
                  crash_sites: Optional[Dict[str, int]] = None,
+                 dead_partitions: Optional[Iterable[int]] = None,
+                 straggler_stalls: Optional[Dict[int, float]] = None,
                  server_drop_push: float = 0.0,
                  server_pull_delay_s: float = 0.0,
                  serving_stalls: Optional[Dict[int, float]] = None,
@@ -102,6 +104,18 @@ class FaultPlan:
         self.crash_partition = crash_partition
         self.crash_after_pushes = int(crash_after_pushes)
         self.crash_sites = dict(crash_sites or {})
+        # Partitions that die on EVERY attempt (machine gone, not task flake):
+        # the elastic-quorum scenario — retries are futile and the membership
+        # layer must expire these members and commit without them.
+        self.dead_partitions = frozenset(
+            int(p) for p in (dead_partitions or ())
+        )
+        # Deterministic straggler injection: partition -> seconds stalled at
+        # the start of attempt 0 (backup attempts run at full speed, so
+        # first-finish-wins has a winner).
+        self.straggler_stalls = {
+            int(p): float(s) for p, s in (straggler_stalls or {}).items()
+        }
         self.server_drop_push = float(server_drop_push)
         self.server_pull_delay_s = float(server_pull_delay_s)
         self.serving_stalls = dict(serving_stalls or {})
@@ -174,8 +188,23 @@ class FaultPlan:
         """Kill the worker for ``crash_partition`` mid-partition (attempt 0
         only, at most once) — the synchronous-path crash, placed by the
         worker AFTER local training so the computed delta is genuinely
-        lost and must be recomputed by the retry."""
-        if ctx is None or self.crash_partition is None:
+        lost and must be recomputed by the retry.
+
+        ``dead_partitions`` members die here too, on EVERY attempt — a
+        permanently lost machine rather than a one-off task flake. Those
+        crashes are what the quorum path must commit around.
+        """
+        if ctx is None:
+            return
+        if ctx.partitionId() in self.dead_partitions:
+            with self._lock:
+                site = f"dead-partition-{ctx.partitionId()}"
+                self.fired[site] = self.fired.get(site, -1) + 1
+            raise InjectedWorkerCrash(
+                f"injected permanent death of partition {ctx.partitionId()} "
+                f"(attempt {ctx.attemptNumber()})"
+            )
+        if self.crash_partition is None:
             return
         if ctx.partitionId() != self.crash_partition or ctx.attemptNumber():
             return
@@ -187,6 +216,21 @@ class FaultPlan:
         raise InjectedWorkerCrash(
             f"injected mid-partition crash of partition {ctx.partitionId()}"
         )
+
+    def straggler_stall(self, ctx) -> None:
+        """Stall the worker for a ``straggler_stalls`` partition at the start
+        of attempt 0 — deterministic slow-node injection. Backup attempts
+        (attempt > 0) are NOT stalled, so a launched backup clone finishes
+        first and first-finish-wins has a deterministic winner."""
+        if ctx is None or not self.straggler_stalls:
+            return
+        if ctx.attemptNumber():
+            return
+        stall = self.straggler_stalls.get(ctx.partitionId())
+        if stall:
+            with self._lock:
+                self.fired[f"straggle-partition-{ctx.partitionId()}"] = 0
+            self.sleep(stall)
 
     # -- coarse crash points (fit chunks, arbitrary sites) ---------------
     def tick(self, site: str) -> None:
@@ -256,10 +300,23 @@ class FaultyClient(BaseParameterClient):
     def update_parameters(self, delta) -> None:
         self._push(lambda: self.inner.update_parameters(delta))
 
-    def update_parameters_tagged(self, task_id: str, delta) -> None:
-        self._push(
-            lambda: self.inner.update_parameters_tagged(task_id, delta)
-        )
+    def update_parameters_tagged(self, task_id: str, delta,
+                                 attempt=None) -> None:
+        # Forward the attempt tag only when set: plain two-arg inner clients
+        # (and pre-fencing fakes in tests) keep working unchanged.
+        if attempt is None:
+            self._push(
+                lambda: self.inner.update_parameters_tagged(task_id, delta)
+            )
+        else:
+            self._push(
+                lambda: self.inner.update_parameters_tagged(
+                    task_id, delta, attempt=attempt
+                )
+            )
+
+    def get_version(self) -> int:
+        return self.inner.get_version()
 
     def register_attempt(self, task_id: str, attempt: int) -> bool:
         return self.inner.register_attempt(task_id, attempt)

@@ -228,6 +228,185 @@ class CircuitBreaker:
         return result
 
 
+class FailoverClient(BaseParameterClient):
+    """Multi-endpoint parameter client: primary + hot standbys.
+
+    Wraps an ordered list of endpoint clients (endpoint 0 = primary) with a
+    circuit breaker per endpoint. Every operation tries the active endpoint
+    first; when it fails transiently (or its breaker is open), the call
+    fails over to the next endpoint in order — transparently, within the
+    same logical call. The caller never learns the primary died.
+
+    Staleness bound on failover: the client tracks the highest weight
+    *version* it has observed (parameter servers expose a monotonic update
+    counter — :meth:`~elephas_tpu.parameter.client.BaseParameterClient.
+    get_version`). When traffic moves to a standby, the client polls the
+    standby's version until it has caught up to the last observed version
+    (or ``staleness_wait_s`` elapses, since an abruptly killed primary may
+    have applied updates that never left its replication queue). So reads
+    after failover are bounded-stale, not arbitrarily stale.
+
+    Failovers are observable: ``failovers`` counts them, and a
+    :class:`~elephas_tpu.resilience.membership.HeartbeatRegistry` passed as
+    ``registry`` receives an event per failover (surfaced in its JSON
+    snapshot).
+
+    Push semantics across failover are at-least-once, exactly like a plain
+    retried push: a push that timed out on the dying primary may have been
+    applied and replicated before the client re-sends it to the standby.
+    Attempt-tagged pushes stay bounded by the server's rollback/fence
+    machinery; untagged pushes inherit the reference's documented
+    at-least-once contract.
+    """
+
+    def __init__(self, endpoints, *,
+                 breakers=None,
+                 failure_threshold: int = 2,
+                 reset_timeout_s: float = 5.0,
+                 registry=None,
+                 staleness_wait_s: float = 2.0,
+                 poll_s: float = 0.05,
+                 sleep: Callable[[float], None] = time.sleep,
+                 clock: Callable[[], float] = time.monotonic,
+                 is_transient: Callable[[BaseException], bool] = default_is_transient):
+        if not endpoints:
+            raise ValueError("FailoverClient needs at least one endpoint")
+        self.endpoints = list(endpoints)
+        self.breakers = (
+            list(breakers) if breakers is not None
+            else [CircuitBreaker(failure_threshold=failure_threshold,
+                                 reset_timeout_s=reset_timeout_s,
+                                 clock=clock)
+                  for _ in self.endpoints]
+        )
+        if len(self.breakers) != len(self.endpoints):
+            raise ValueError("one breaker per endpoint")
+        self.registry = registry
+        self.staleness_wait_s = float(staleness_wait_s)
+        self.poll_s = float(poll_s)
+        self.sleep = sleep
+        self.clock = clock
+        self.is_transient = is_transient
+        self._lock = threading.Lock()
+        self._active = 0
+        self._last_version = -1
+        self.failovers = 0
+
+    @property
+    def active_endpoint(self) -> int:
+        with self._lock:
+            return self._active
+
+    def _note_version(self, endpoint) -> None:
+        seen = getattr(endpoint, "last_seen_version", -1)
+        if seen is None:
+            return
+        with self._lock:
+            if seen > self._last_version:
+                self._last_version = int(seen)
+
+    def _await_catchup(self, endpoint) -> None:
+        """Bound read staleness: wait (briefly) for the standby's version
+        counter to reach the last version this client observed."""
+        with self._lock:
+            target = self._last_version
+        if target < 0 or self.staleness_wait_s <= 0:
+            return
+        deadline = self.clock() + self.staleness_wait_s
+        while True:
+            try:
+                version = endpoint.get_version()
+                if version < 0 or version >= target:
+                    # <0 = backend exposes no version counter: staleness
+                    # cannot be bounded, don't burn the wait budget on it
+                    return
+            except BaseException as err:  # noqa: BLE001 - transient probe
+                if not self.is_transient(err):
+                    raise
+            if self.clock() >= deadline:
+                return
+            self.sleep(self.poll_s)
+
+    def _failover_to(self, index: int) -> None:
+        with self._lock:
+            if self._active == index:
+                return
+            self._active = index
+            self.failovers += 1
+            version = self._last_version
+        if self.registry is not None:
+            self.registry.observe_failover(
+                endpoint=index, version=None if version < 0 else version
+            )
+
+    def _run(self, op: Callable[[BaseParameterClient], T], describe: str) -> T:
+        with self._lock:
+            start = self._active
+        last_err: Optional[BaseException] = None
+        for k in range(len(self.endpoints)):
+            i = (start + k) % len(self.endpoints)
+            endpoint, breaker = self.endpoints[i], self.breakers[i]
+            if not breaker.allow():
+                last_err = CircuitOpenError(
+                    f"{describe}: endpoint {i} breaker is open"
+                )
+                continue
+            if i != start:
+                self._await_catchup(endpoint)
+            try:
+                result = op(endpoint)
+            except BaseException as err:  # noqa: BLE001 - filtered below
+                breaker.record_failure()
+                if not self.is_transient(err):
+                    raise
+                last_err = err
+                continue
+            breaker.record_success()
+            if i != start:
+                self._failover_to(i)
+            self._note_version(endpoint)
+            return result
+        assert last_err is not None
+        raise last_err
+
+    def get_parameters(self):
+        return self._run(lambda c: c.get_parameters(), "get_parameters")
+
+    def get_version(self) -> int:
+        return self._run(lambda c: c.get_version(), "get_version")
+
+    def update_parameters(self, delta) -> None:
+        self._run(lambda c: c.update_parameters(delta), "update_parameters")
+
+    def update_parameters_tagged(self, task_id: str, delta,
+                                 attempt=None) -> None:
+        if attempt is None:
+            self._run(lambda c: c.update_parameters_tagged(task_id, delta),
+                      "update_parameters_tagged")
+        else:
+            self._run(
+                lambda c: c.update_parameters_tagged(
+                    task_id, delta, attempt=attempt
+                ),
+                "update_parameters_tagged",
+            )
+
+    def register_attempt(self, task_id: str, attempt: int) -> bool:
+        return self._run(
+            lambda c: c.register_attempt(task_id, attempt), "register_attempt"
+        )
+
+    def commit_attempt(self, task_id: str) -> None:
+        self._run(lambda c: c.commit_attempt(task_id), "commit_attempt")
+
+    def close(self) -> None:
+        for endpoint in self.endpoints:
+            try:
+                endpoint.close()
+            except Exception:  # noqa: BLE001 - best-effort teardown
+                pass
+
+
 class ResilientClient(BaseParameterClient):
     """Route a parameter client's traffic through breaker → retry.
 
@@ -260,11 +439,25 @@ class ResilientClient(BaseParameterClient):
             lambda: self.inner.update_parameters(delta), "update_parameters"
         )
 
-    def update_parameters_tagged(self, task_id: str, delta) -> None:
-        self._guarded(
-            lambda: self.inner.update_parameters_tagged(task_id, delta),
-            "update_parameters_tagged",
-        )
+    def update_parameters_tagged(self, task_id: str, delta,
+                                 attempt=None) -> None:
+        # Forward the attempt tag only when set so plain two-arg inner
+        # clients keep working unchanged.
+        if attempt is None:
+            self._guarded(
+                lambda: self.inner.update_parameters_tagged(task_id, delta),
+                "update_parameters_tagged",
+            )
+        else:
+            self._guarded(
+                lambda: self.inner.update_parameters_tagged(
+                    task_id, delta, attempt=attempt
+                ),
+                "update_parameters_tagged",
+            )
+
+    def get_version(self) -> int:
+        return self._guarded(self.inner.get_version, "get_version")
 
     def register_attempt(self, task_id: str, attempt: int) -> bool:
         return self._guarded(

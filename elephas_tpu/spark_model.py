@@ -75,6 +75,10 @@ class SparkModel:
                  master_optimizer=None, master_loss=None, master_metrics=None,
                  fault_plan=None, retry_policy=None,
                  ps_timeout: float = 60.0,
+                 membership=None, quorum: Optional[int] = None,
+                 round_deadline_s: Optional[float] = None,
+                 backup_stragglers: bool = True,
+                 hot_standby: bool = False,
                  *args, **kwargs):
         if mode not in ("synchronous", "asynchronous", "hogwild"):
             raise ValueError(f"Unknown mode: {mode}")
@@ -133,6 +137,37 @@ class SparkModel:
         self.fault_plan = fault_plan
         self.retry_policy = retry_policy
         self.ps_timeout = float(ps_timeout)
+        # Elastic-membership extensions (elephas_tpu.resilience.membership):
+        # a HeartbeatRegistry drives K-of-N quorum rounds with straggler
+        # backups on the host paths and masks expired workers out of the
+        # compiled path's merge; hot_standby adds a replicated standby
+        # parameter server that clients fail over to when the primary dies.
+        self.membership = membership
+        self.quorum = None if quorum is None else int(quorum)
+        self.round_deadline_s = round_deadline_s
+        self.backup_stragglers = bool(backup_stragglers)
+        self.hot_standby = bool(hot_standby)
+        if self.quorum is not None and self.quorum < 1:
+            raise ValueError("quorum must be >= 1")
+        if self.quorum is not None and membership is None:
+            raise ValueError(
+                "quorum requires a membership registry "
+                "(membership=HeartbeatRegistry(...))"
+            )
+        if self.hot_standby:
+            if self.comm != "host" or mode == "synchronous":
+                raise ValueError(
+                    "hot_standby needs a live parameter server: use an "
+                    "asynchronous/hogwild mode with comm='host' "
+                    f"(got mode={mode!r}, comm={self.comm!r})"
+                )
+            if parameter_server_mode not in ("http", "socket"):
+                raise ValueError(
+                    "hot_standby supports the http/socket parameter servers "
+                    f"(got {parameter_server_mode!r})"
+                )
+        self._standby_server = None
+        self._ps_stats: Dict[str, Any] = {}
         self._fit_kwargs: Dict[str, Any] = {}
         self.training_histories: List[Dict[str, Any]] = []
         self.timings: List[Dict[str, float]] = []
@@ -265,6 +300,37 @@ class SparkModel:
             self._jax_trainer_model = self._master_network
         return self._jax_trainer
 
+    def _membership_mask(self, n: int):
+        """K-of-N mask for the fused-program path: ``worker_valid`` floats
+        for :meth:`CompiledTrainer.fit`, or ``None`` when every worker is
+        live (keeps the common case on the cached no-mask executable).
+
+        The fused program cannot lose a worker mid-flight (all workers are
+        one XLA program), so membership here models *external* liveness —
+        hosts the registry saw die between rounds. Unknown members default
+        to live: the jax path never heartbeats per-batch.
+        """
+        if self.membership is None:
+            return None
+        from .resilience.membership import (
+            QuorumLostError, member_id_for,
+        )
+
+        self.membership.sweep()
+        mask = [
+            1.0 if self.membership.is_live(member_id_for(i), default=True)
+            else 0.0
+            for i in range(n)
+        ]
+        live = int(sum(mask))
+        if self.quorum is not None and live < self.quorum:
+            raise QuorumLostError(
+                f"{live} of {n} workers live, quorum is {self.quorum}"
+            )
+        if live == n:
+            return None
+        return mask
+
     # -- fast path: one XLA program over the mesh ------------------------
     def _fit_jax(self, rdd, epochs, batch_size, verbose, validation_split):
         blocks = self._partition_blocks(rdd, batch_size)
@@ -281,6 +347,7 @@ class SparkModel:
             result = trainer.fit(
                 blocks, epochs=epochs, batch_size=batch_size,
                 validation_split=validation_split, verbose=verbose,
+                worker_valid=self._membership_mask(len(blocks)),
             )
             self.training_histories.append(result.history)
             self.timings.append(result.timings)
@@ -347,6 +414,7 @@ class SparkModel:
                     seed=0, epoch_offset=epoch, opt_state=opt_state,
                     keep_opt_state=True, worker_state=worker_state,
                     keep_worker_state=True,
+                    worker_valid=self._membership_mask(len(blocks)),
                 )
                 worker_state = result.worker_state
             else:
@@ -354,6 +422,7 @@ class SparkModel:
                     blocks, epochs=chunk, batch_size=batch_size,
                     validation_split=validation_split, verbose=verbose,
                     seed=epoch, opt_state=opt_state, keep_opt_state=True,
+                    worker_valid=self._membership_mask(len(blocks)),
                 )
             opt_state = result.opt_state
             for k, v in result.history.items():
@@ -389,7 +458,26 @@ class SparkModel:
             self.master_optimizer, self.master_loss, self.master_metrics,
             self.custom_objects, fault_plan=self.fault_plan,
         )
-        results = rdd.mapPartitions(worker.train).collect()
+        if self.membership is not None:
+            # Elastic round: K-of-N commit with straggler backups instead of
+            # blocking on every partition (DeepSpark partial aggregation).
+            # The mean below is over the RECEIVED deltas only.
+            from .resilience.membership import QuorumRunner
+
+            runner = QuorumRunner(
+                self.membership, quorum=self.quorum,
+                round_deadline_s=self.round_deadline_s,
+                backup_stragglers=self.backup_stragglers,
+                max_failures=rdd.context.maxTaskFailures,
+            )
+            committed = runner.run(
+                rdd.partitions(), worker.train,
+                stage_id=rdd.context._next_stage_id(),
+            )
+            results = [item for pid in sorted(committed)
+                       for item in committed[pid]]
+        else:
+            results = rdd.mapPartitions(worker.train).collect()
         deltas = [r[0] for r in results]
         self.training_histories.extend(r[1] for r in results if r[1])
         if not deltas:
@@ -418,10 +506,20 @@ class SparkModel:
             cls = SocketServer
         self._server = cls(
             weights, mode=self.mode, port=self.port,
-            fault_plan=self.fault_plan,
+            fault_plan=self.fault_plan, name="primary",
         )
         self._server.start()
         self.port = self._server.port  # native server may bind an OS port
+        if self.hot_standby:
+            # The standby gets NO fault plan: it is the recovery target, and
+            # sharing the primary's plan would also re-consult server-side
+            # drop decisions on replicated deltas (losing committed updates
+            # is exactly what the standby exists to prevent).
+            self._standby_server = cls(
+                weights, mode=self.mode, port=0, name="standby",
+            )
+            self._standby_server.start()
+            self._server.attach_standby(self._standby_server)
 
     def _make_client(self) -> BaseParameterClient:
         if self.parameter_server_mode == "native":
@@ -441,6 +539,19 @@ class SparkModel:
                 self.parameter_server_mode, self.port, host="127.0.0.1",
                 timeout=self.ps_timeout,
             )
+            if self._standby_server is not None:
+                from .resilience.policy import FailoverClient
+
+                # Bottom of the wrapper stack: transport selection. Injected
+                # wire faults (FaultyClient, above) stay retryable without
+                # tripping a failover; only genuine endpoint death does.
+                standby = BaseParameterClient.get_client(
+                    self.parameter_server_mode, self._standby_server.port,
+                    host="127.0.0.1", timeout=self.ps_timeout,
+                )
+                client = FailoverClient(
+                    [client, standby], registry=self.membership,
+                )
             if self.fault_plan is not None:
                 from .resilience.faults import FaultyClient
 
@@ -462,8 +573,49 @@ class SparkModel:
 
     def stop_server(self) -> None:
         if self._server is not None:
+            if self._standby_server is not None:
+                # let in-flight replication land before reading counters
+                self._server.flush_replication()
+            self._ps_stats = {
+                name: {
+                    "version": int(getattr(server, "version", -1)),
+                    "rejected_stale": int(
+                        getattr(server, "rejected_stale", 0)
+                    ),
+                    "replication_errors": int(
+                        getattr(server, "replication_errors", 0)
+                    ),
+                    "applied_tagged": {
+                        k: int(v)
+                        for k, v in getattr(
+                            server, "applied_tagged", {}
+                        ).items()
+                    },
+                }
+                for name, server in (
+                    ("primary", self._server),
+                    ("standby", self._standby_server),
+                )
+                if server is not None
+            }
             self._server.stop()
             self._server = None
+        if self._standby_server is not None:
+            self._standby_server.stop()
+            self._standby_server = None
+
+    def membership_snapshot(self) -> Dict[str, Any]:
+        """JSON-able elastic-training observability: registry events (joins,
+        expiries, epoch bumps, backups, failovers, per-round shortfall) plus
+        the last fit's parameter-server version/fencing/replication counters.
+        Style matches ``ServingMetrics.snapshot()``."""
+        snap: Dict[str, Any] = {
+            "membership": None, "counters": {}, "rounds": [], "events": [],
+        }
+        if self.membership is not None:
+            snap = self.membership.snapshot()
+        snap["parameter_servers"] = dict(self._ps_stats)
+        return snap
 
     def _fit_host_async(self, rdd, epochs, batch_size, verbose, validation_split):
         model = self._master_network
@@ -478,7 +630,8 @@ class SparkModel:
             }
 
             def make_train(json_config, make_client, train_config, frequency,
-                           opt, loss, metrics, custom_objects):
+                           opt, loss, metrics, custom_objects, fault_plan,
+                           registry):
                 # Each partition gets its OWN client (thread) — mirrors one
                 # client per executor in the reference.
                 def run(iterator):
@@ -487,6 +640,7 @@ class SparkModel:
                         worker = AsynchronousSparkWorker(
                             json_config, client, train_config, frequency,
                             opt, loss, metrics, custom_objects,
+                            fault_plan=fault_plan, registry=registry,
                         )
                         yield from worker.train(iterator)
                     finally:
@@ -500,8 +654,37 @@ class SparkModel:
                 model.to_json(), self._make_client,
                 train_config, self.frequency, self.master_optimizer,
                 self.master_loss, self.master_metrics, self.custom_objects,
+                self.fault_plan, self.membership,
             )
-            rdd.mapPartitions(fn).collect()
+            if self.membership is not None:
+                # Elastic async round: same K-of-N/backup machinery as the
+                # sync path; "reporting" here means the worker finished its
+                # pushes. Partitions abandoned at the deadline get their
+                # task fenced at the server — a superseding register rolls
+                # back their uncommitted pushes and rejects any still coming
+                # (late deltas dead by membership epoch).
+                from .resilience.membership import QuorumRunner
+
+                runner = QuorumRunner(
+                    self.membership, quorum=self.quorum,
+                    round_deadline_s=self.round_deadline_s,
+                    backup_stragglers=self.backup_stragglers,
+                    max_failures=rdd.context.maxTaskFailures,
+                )
+                stage_id = rdd.context._next_stage_id()
+                runner.run(rdd.partitions(), fn, stage_id=stage_id)
+                if runner.abandoned:
+                    fencer = self._make_client()
+                    try:
+                        for pid in runner.abandoned:
+                            fencer.register_attempt(
+                                f"stage-{stage_id}-partition-{pid}",
+                                1 << 20,
+                            )
+                    finally:
+                        fencer.close()
+            else:
+                rdd.mapPartitions(fn).collect()
             client = self._make_client()
             try:
                 new_parameters = client.get_parameters()

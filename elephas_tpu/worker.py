@@ -96,6 +96,13 @@ class SparkWorker:
         if x_train.shape[0] <= batch_size:
             # Reference quirk: partitions no larger than one batch are skipped.
             return
+        if self.fault_plan is not None:
+            from .data import TaskContext
+
+            # Injected slow node: attempt 0 of a straggler_stalls partition
+            # stalls here, BEFORE training — the membership registry flags
+            # the silence and the quorum runner races a backup clone.
+            self.fault_plan.straggler_stall(TaskContext.get())
         model = _build_model(
             self.json_config, self.custom_objects, self.master_optimizer,
             self.master_loss, self.master_metrics,
@@ -123,7 +130,8 @@ class AsynchronousSparkWorker:
     def __init__(self, json_config: str, client: BaseParameterClient,
                  train_config: Dict[str, Any], frequency: str,
                  master_optimizer, master_loss, master_metrics,
-                 custom_objects: Optional[dict] = None):
+                 custom_objects: Optional[dict] = None, fault_plan=None,
+                 registry=None):
         self.json_config = json_config
         self.client = client
         self.train_config = dict(train_config)
@@ -132,6 +140,11 @@ class AsynchronousSparkWorker:
         self.master_loss = master_loss
         self.master_metrics = master_metrics
         self.custom_objects = custom_objects
+        # Elastic extensions: straggler-stall injection (fault_plan) and
+        # heartbeat-lease renewal (registry — a resilience.HeartbeatRegistry,
+        # duck-typed) so the driver can tell slow from dead mid-fit.
+        self.fault_plan = fault_plan
+        self.registry = registry
 
     def train(self, data_iterator: Iterator):
         data = _materialize(data_iterator)
@@ -141,6 +154,18 @@ class AsynchronousSparkWorker:
         batch_size = int(self.train_config.get("batch_size", 32))
         if x_train.shape[0] <= batch_size:
             return
+        from .data import TaskContext
+
+        ctx = TaskContext.get()
+        if self.fault_plan is not None:
+            # Injected slow node (attempt 0 only; a backup clone runs at
+            # full speed so first-finish-wins has a winner).
+            self.fault_plan.straggler_stall(ctx)
+
+        def beat():
+            if self.registry is not None and ctx is not None:
+                self.registry.heartbeat(f"partition-{ctx.partitionId()}")
+
         model = _build_model(
             self.json_config, self.custom_objects, self.master_optimizer,
             self.master_loss, self.master_metrics,
@@ -154,9 +179,6 @@ class AsynchronousSparkWorker:
         # (the reference's async path is NOT retry-idempotent — SURVEY.md
         # §5.3). Degrades to untagged pushes when the server predates the
         # attempt API.
-        from .data import TaskContext
-
-        ctx = TaskContext.get()
         task_id = None
         if ctx is not None:
             candidate = task_id_for(ctx)
@@ -177,12 +199,17 @@ class AsynchronousSparkWorker:
 
         def push(delta):
             if task_id is not None:
-                self.client.update_parameters_tagged(task_id, delta)
+                # attempt-tagged: the server fences pushes from superseded
+                # attempts (a zombie straggler whose backup already won)
+                self.client.update_parameters_tagged(
+                    task_id, delta, attempt=ctx.attemptNumber()
+                )
             else:
                 self.client.update_parameters(delta)
 
         if self.frequency == "epoch":
             for _epoch in range(epochs):
+                beat()
                 weights_before = self.client.get_parameters()
                 model.set_weights(weights_before)
                 model.fit(
@@ -200,6 +227,7 @@ class AsynchronousSparkWorker:
             for _epoch in range(epochs):
                 indices = np.random.permutation(n)
                 for b in range(nbatch):
+                    beat()
                     idx = indices[b * batch_size:(b + 1) * batch_size]
                     weights_before = self.client.get_parameters()
                     model.set_weights(weights_before)

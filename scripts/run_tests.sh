@@ -20,10 +20,21 @@
 #
 # Environment (test env vars, e.g. JAX_PLATFORMS) must be set by the
 # caller; `make test` does that.
+#
+# Marker groups: ELEPHAS_TEST_GROUP=<marker> (e.g. `chaos`) restricts every
+# shard to that pytest marker. The group's `-m` is appended AFTER the
+# caller's args because pytest honors only the LAST -m — so
+# `ELEPHAS_TEST_GROUP=chaos make test-fast` runs the chaos group even
+# though the Makefile target itself passes `-m "not slow"`.
 set -u
 
 WATCHDOG_FILE="${ELEPHAS_WATCHDOG_FILE:-$(mktemp /tmp/elephas_watchdog.XXXXXX)}"
 export ELEPHAS_WATCHDOG_FILE="$WATCHDOG_FILE"
+
+group_args=()
+if [ -n "${ELEPHAS_TEST_GROUP:-}" ]; then
+  group_args=(-m "$ELEPHAS_TEST_GROUP")
+fi
 
 # Top-level shards: every directory under tests/ plus tests/ itself
 # non-recursively (pytest.ini-style rootdir files). New test trees are
@@ -56,7 +67,7 @@ run_shard() {
 
   for attempt in 1 2 3 4; do
     rm -f "$WATCHDOG_FILE"
-    python -m pytest "${target[@]}" "$@" "${deselect[@]}"
+    python -m pytest "${target[@]}" "$@" "${group_args[@]}" "${deselect[@]}"
     rc=$?
     if [ "$rc" -eq 5 ]; then  # no tests collected in this shard
       return 0

@@ -145,6 +145,58 @@ def test_commit_frees_accumulator_and_keeps_weights(server_cls):
         server.stop()
 
 
+@pytest.mark.parametrize("server_cls", ["http", "socket"])
+def test_attempt_record_eviction_rolls_back_and_keeps_exactly_once(server_cls):
+    """_MAX_ATTEMPT_RECORDS bounds server memory on long-lived servers by
+    evicting the oldest attempt record. The eviction must roll the evicted
+    task's uncommitted contribution back (it is presumed dead), so that a
+    task that nonetheless retries later re-pushes from scratch and nothing
+    double-applies — exactly-once survives the eviction."""
+    server, client = start(server_cls)
+    server._MAX_ATTEMPT_RECORDS = 4   # instance override: tiny cap
+    try:
+        client.register_attempt("victim", 0)
+        client.update_parameters_tagged("victim", delta(1.0))
+        for i in range(3):
+            client.register_attempt(f"filler-{i}", 0)
+        assert attempt_count(server) == 4
+        # one past the cap: the oldest ("victim") is evicted and its
+        # uncommitted 1.0 rolled back
+        client.register_attempt("overflow", 0)
+        assert attempt_count(server) == 4
+        got = client.get_parameters()
+        np.testing.assert_allclose(got[0], W0[0])
+        np.testing.assert_allclose(got[1], W0[1])
+        # the evicted task retries: it re-registers from scratch and its
+        # new pushes apply exactly once (no ghost of the rolled-back 1.0)
+        assert client.register_attempt("victim", 1) is True
+        client.update_parameters_tagged("victim", delta(5.0))
+        got = client.get_parameters()
+        np.testing.assert_allclose(got[0], W0[0] - 5.0)
+        np.testing.assert_allclose(got[1], W0[1] - 5.0)
+    finally:
+        client.close()
+        server.stop()
+
+
+@pytest.mark.parametrize("server_cls", ["http", "socket"])
+def test_eviction_of_committed_free_records_rolls_back_nothing(server_cls):
+    """Records with no uncommitted pushes evict without touching weights."""
+    server, client = start(server_cls)
+    server._MAX_ATTEMPT_RECORDS = 2
+    try:
+        client.register_attempt("a", 0)       # never pushes
+        client.register_attempt("b", 0)
+        client.update_parameters_tagged("b", delta(2.0))
+        client.register_attempt("c", 0)       # evicts "a": no rollback
+        got = client.get_parameters()
+        np.testing.assert_allclose(got[0], W0[0] - 2.0)
+        assert attempt_count(server) == 2
+    finally:
+        client.close()
+        server.stop()
+
+
 def test_http_register_transient_error_raises_not_degrades():
     """A 503 from /register is a transient fault on an attempt-API-capable
     server — the client must surface it (task retry handles it), NOT silently

@@ -84,3 +84,58 @@ def test_hogwild_skips_lock():
         client.close()
     finally:
         server.stop()
+
+
+def test_socket_client_reconnects_after_peer_reset():
+    """A persistent socket goes stale when the peer resets (server restart,
+    idle LB reap). Every op must retry once on a fresh connection instead of
+    failing the worker task on the first post-reset call."""
+    port = next(PORTS)
+    server = SocketServer(_weights(), mode="asynchronous", port=port)
+    server.start()
+    try:
+        client = BaseParameterClient.get_client("socket", port, host="127.0.0.1")
+        assert np.allclose(client.get_parameters()[0], 1.0)
+        # simulate the peer reset underneath the live client: the next send
+        # on this socket raises ConnectionError/OSError
+        import socket as socket_mod
+
+        client._sock.shutdown(socket_mod.SHUT_RDWR)
+        client._sock.close()
+        # pulls, pushes, and version reads all recover on a fresh connection
+        assert np.allclose(client.get_parameters()[0], 1.0)
+        client._sock.close()
+        client.update_parameters(
+            [np.full((4, 3), 0.5, "float32"), np.zeros((3,), "float32")]
+        )
+        # 'u' is fire-and-forget and the reconnect put it on a NEW
+        # connection: poll until the server has drained it.
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if np.allclose(client.get_parameters()[0], 0.5):
+                break
+            time.sleep(0.05)
+        assert np.allclose(client.get_parameters()[0], 0.5)
+        client._sock.close()
+        assert client.get_version() >= 1
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_socket_client_raises_when_server_genuinely_gone():
+    """The one-shot reconnect must not loop forever on a dead server: the
+    second failure propagates (the policy layer owns further retries)."""
+    port = next(PORTS)
+    server = SocketServer(_weights(), mode="asynchronous", port=port)
+    server.start()
+    client = BaseParameterClient.get_client("socket", port, host="127.0.0.1")
+    assert np.allclose(client.get_parameters()[0], 1.0)
+    server.stop()
+    # the established connection may outlive the listener; drop it so the
+    # reconnect path has to dial the (now closed) listener and fail honestly
+    client._sock.close()
+    client._sock = None
+    with pytest.raises(OSError):
+        client.get_parameters()
+    client.close()

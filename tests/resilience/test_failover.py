@@ -1,0 +1,204 @@
+"""Pinned failover scenarios (ISSUE acceptance criteria, scenarios a + c):
+
+- hot-standby parameter server: the primary is killed mid-epoch by a seeded
+  FaultPlan; training must complete against the standby, no committed update
+  may be lost (standby version >= primary version after replication drains),
+  and the weight version counter stays monotone across the failover;
+- injected straggler: the backup clone wins, and the server applies exactly
+  the winner's deltas for that task id — the zombie's late pushes are fenced.
+
+Plus deterministic server-level tests of the attempt fence and the version
+counter (no threads, no timing)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from elephas_tpu import SparkModel
+from elephas_tpu.parameter.client import HttpClient
+from elephas_tpu.parameter.server import HttpServer
+from elephas_tpu.resilience import FaultPlan, HeartbeatRegistry, RetryPolicy
+from elephas_tpu.utils import to_simple_rdd
+
+from ..conftest import make_classifier
+
+pytestmark = pytest.mark.resilience
+
+
+@pytest.fixture(scope="module")
+def failover_data():
+    rng = np.random.default_rng(23)
+    n, d, c = 200, 10, 3            # 4 partitions x 50 samples (> batch 16)
+    x = rng.normal(size=(n, d)).astype("float32")
+    w = rng.normal(size=(d, c))
+    y = np.eye(c, dtype="float32")[(x @ w).argmax(axis=1)]
+    return x, y
+
+
+@pytest.mark.chaos
+def test_training_survives_primary_ps_kill(spark_context, failover_data):
+    """Scenario a pinned: the primary dies at its 13th request (mid-epoch,
+    after real updates have been applied). Clients must transparently
+    re-target the standby, training must complete with a lower loss, and
+    the standby must hold every update the primary committed."""
+    x, y = failover_data
+    model = make_classifier(hidden=8, optimizer="sgd")
+    loss_before = float(model.evaluate(x, y, verbose=0)[0])
+
+    plan = FaultPlan(seed=5, crash_sites={"kill-primary": 12})
+    registry = HeartbeatRegistry(lease_s=120.0)
+    sm = SparkModel(
+        model, mode="asynchronous", num_workers=4, comm="host",
+        parameter_server_mode="http", port=0, fault_plan=plan,
+        membership=registry, hot_standby=True,
+        retry_policy=RetryPolicy(max_attempts=4, base_delay_s=0.01,
+                                 max_delay_s=0.05),
+    )
+    sm.fit(to_simple_rdd(spark_context, x, y), epochs=2, batch_size=16,
+           verbose=0, validation_split=0.0, shuffle=False)
+
+    assert "kill-primary" in plan.fired, "the injected PS kill never fired"
+    snap = sm.membership_snapshot()
+    assert snap["counters"]["failovers"] >= 1
+    ps = snap["parameter_servers"]
+    # updates were committed on the primary BEFORE it died, and none were
+    # lost: after replication drains the standby has them all, plus the
+    # post-failover ones — the version counter is monotone across servers
+    assert ps["primary"]["version"] > 0
+    assert ps["standby"]["version"] >= ps["primary"]["version"]
+    assert ps["primary"]["replication_errors"] == 0
+    # total applied pushes (4 workers x 2 epochs) all landed somewhere
+    assert ps["standby"]["version"] == 8
+
+    final = model.get_weights()
+    for w in final:
+        assert np.all(np.isfinite(np.asarray(w)))
+    loss_after = float(model.evaluate(x, y, verbose=0)[0])
+    assert loss_after < loss_before
+
+
+@pytest.mark.chaos
+def test_straggler_backup_wins_and_server_applies_winner_only(
+        spark_context, failover_data):
+    """Scenario c pinned: partition 1 stalls 9s before registering; the
+    registry flags the silence after 3s and a backup clone (attempt 1)
+    races ahead. The server must end up with exactly the WINNER's pushes
+    for that task id — one per batch — no matter when the zombie wakes."""
+    x, y = failover_data
+    model = make_classifier(hidden=8, optimizer="sgd")
+    loss_before = float(model.evaluate(x, y, verbose=0)[0])
+
+    release = threading.Event()
+    plan = FaultPlan(seed=7, straggler_stalls={1: 9.0},
+                     sleep=lambda s: release.wait(s))
+    registry = HeartbeatRegistry(lease_s=120.0, straggler_after_s=3.0)
+    sm = SparkModel(
+        model, mode="asynchronous", frequency="batch", num_workers=4,
+        comm="host", parameter_server_mode="http", port=0, fault_plan=plan,
+        membership=registry,
+        retry_policy=RetryPolicy(max_attempts=2, base_delay_s=0.01,
+                                 max_delay_s=0.02),
+    )
+    try:
+        sm.fit(to_simple_rdd(spark_context, x, y), epochs=1, batch_size=16,
+               verbose=0, validation_split=0.0, shuffle=False)
+    finally:
+        release.set()               # wake the zombie; the server is gone
+
+    assert "straggle-partition-1" in plan.fired
+    snap = sm.membership_snapshot()
+    assert snap["counters"].get("backup", 0) >= 1
+    assert any(e["kind"] == "backup" and e["member"] == "partition-1"
+               for e in snap["events"])
+    # exactly-once for the straggler's task: 50 samples / 16 per batch = 3
+    # batches, so exactly 3 applied deltas — the backup's, not 6 (backup +
+    # zombie) and not 0
+    applied = snap["parameter_servers"]["primary"]["applied_tagged"]
+    straggler_tasks = {k: v for k, v in applied.items()
+                       if k.endswith("partition-1")}
+    assert list(straggler_tasks.values()) == [3]
+
+    for w in model.get_weights():
+        assert np.all(np.isfinite(np.asarray(w)))
+    loss_after = float(model.evaluate(x, y, verbose=0)[0])
+    assert loss_after < loss_before
+
+
+# -- deterministic server-level fence / version tests ------------------------
+
+
+def _weights():
+    return [np.zeros((3,), np.float32)]
+
+
+def _delta(v=1.0):
+    return [np.full((3,), v, np.float32)]
+
+
+def test_attempt_fence_rejects_zombie_pushes_even_after_commit():
+    """The fence outlives the accumulator: a zombie that wakes up AFTER the
+    winner committed (record popped) must still be refused."""
+    server = HttpServer(_weights(), mode="asynchronous", port=0)
+    server.start()
+    try:
+        client = HttpClient(port=server.port)
+        assert client.register_attempt("task", 1)   # the backup registers
+        client.update_parameters_tagged("task", _delta(), attempt=1)
+        client.commit_attempt("task")
+        applied = np.array(server.weights[0])
+
+        # zombie attempt 0: stale register is ignored, pushes are fenced
+        client.register_attempt("task", 0)
+        client.update_parameters_tagged("task", _delta(5.0), attempt=0)
+        np.testing.assert_array_equal(server.weights[0], applied)
+        assert server.rejected_stale == 1
+        assert server.applied_tagged["task"] == 1
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_version_counter_is_monotone_and_exposed_to_clients():
+    server = HttpServer(_weights(), mode="asynchronous", port=0)
+    server.start()
+    try:
+        client = HttpClient(port=server.port)
+        assert client.get_version() == 0
+        client.update_parameters(_delta())
+        assert client.get_version() == 1
+        client.update_parameters(_delta())
+        assert client.get_version() == 2
+        # pulls report the version too (header), for staleness bounding
+        client.get_parameters()
+        assert client.last_seen_version == 2
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_replication_streams_every_committed_update_to_standby():
+    primary = HttpServer(_weights(), mode="asynchronous", port=0,
+                         name="primary")
+    standby = HttpServer(_weights(), mode="asynchronous", port=0,
+                         name="standby")
+    primary.start()
+    standby.start()
+    primary.attach_standby(standby)
+    try:
+        client = HttpClient(port=primary.port)
+        client.register_attempt("t", 1)
+        for _ in range(3):
+            client.update_parameters_tagged("t", _delta(), attempt=1)
+        client.commit_attempt("t")
+        client.close()
+        primary.flush_replication()
+        assert standby.version == primary.version == 3
+        np.testing.assert_array_equal(standby.weights[0], primary.weights[0])
+        # the attempt table replicated too: a zombie fenced on the primary
+        # is equally fenced on the standby after failover
+        assert standby._fence.get("t") == primary._fence.get("t") == 1
+        assert "t" not in standby._attempts
+    finally:
+        primary.stop()
+        standby.stop()

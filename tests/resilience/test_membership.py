@@ -1,0 +1,280 @@
+"""Unit tests for the elastic-membership layer: HeartbeatRegistry lease /
+epoch / fence semantics under a fake clock, and QuorumRunner's K-of-N round
+mechanics (retries, partial commit, straggler backups, late-result fencing)
+with plain-python tasks — no Keras, no parameter server."""
+
+import threading
+import time
+
+import pytest
+
+from elephas_tpu.data.rdd import TaskContext
+from elephas_tpu.resilience import (
+    HeartbeatRegistry, QuorumLostError, QuorumRunner, member_id_for,
+)
+
+pytestmark = pytest.mark.resilience
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += float(dt)
+
+
+# -- HeartbeatRegistry -------------------------------------------------------
+
+
+def test_join_heartbeat_and_epoch_monotonicity():
+    clock = FakeClock()
+    reg = HeartbeatRegistry(lease_s=5.0, clock=clock)
+    assert reg.epoch == 0
+    e1 = reg.join("a")
+    e2 = reg.join("b")
+    assert (e1, e2) == (1, 2)
+    # heartbeat of a known member renews the lease without an epoch bump
+    clock.advance(1.0)
+    reg.heartbeat("a")
+    assert reg.epoch == 2
+    # heartbeat of an UNKNOWN member is an implicit join (epoch bump)
+    reg.heartbeat("c")
+    assert reg.epoch == 3
+    assert reg.live() == ["a", "b", "c"]
+
+
+def test_sweep_expires_lapsed_leases_and_fences_them():
+    clock = FakeClock()
+    reg = HeartbeatRegistry(lease_s=5.0, clock=clock)
+    reg.join("a")
+    reg.join("b")
+    clock.advance(3.0)
+    reg.heartbeat("b")
+    clock.advance(2.5)          # a: 5.5s silent (expired); b: 2.5s (live)
+    assert reg.sweep() == ["a"]
+    assert reg.live() == ["b"]
+    assert not reg.is_live("a")
+    # the expiry fenced a's results at the bumped epoch
+    assert reg.fence("a") == reg.epoch == 3
+    # rejoin admits the member again but keeps results launched before the
+    # death fenced: fence moves UP to the rejoin epoch, never down
+    reg.join("a")
+    assert reg.is_live("a")
+    assert reg.fence("a") == reg.epoch == 4
+
+
+def test_is_live_default_answers_for_unknown_members_only():
+    reg = HeartbeatRegistry(lease_s=5.0, clock=FakeClock())
+    assert not reg.is_live("ghost")
+    assert reg.is_live("ghost", default=True)      # never seen: caller's call
+    reg.join("ghost")
+    reg.leave("ghost")
+    # seen-and-departed is NOT unknown: default must not resurrect it
+    assert not reg.is_live("ghost", default=True)
+
+
+def test_straggler_window_between_threshold_and_lease():
+    clock = FakeClock()
+    reg = HeartbeatRegistry(lease_s=10.0, straggler_after_s=2.0, clock=clock)
+    reg.join("a")
+    reg.join("b")
+    clock.advance(3.0)
+    reg.heartbeat("b")
+    assert reg.stragglers() == ["a"]    # 3s silent: past threshold, in lease
+    clock.advance(8.0)                  # a now 11s silent: lease lapsed
+    reg.heartbeat("b")
+    assert reg.stragglers() == []       # a past its lease, b just beat
+    assert reg.sweep() == ["a"]
+
+
+def test_snapshot_shape_and_event_bounds():
+    clock = FakeClock()
+    reg = HeartbeatRegistry(lease_s=5.0, straggler_after_s=1.0, clock=clock,
+                            max_events=4)
+    for i in range(10):
+        reg.join(f"m{i}")
+    reg.observe_backup("m1", 1)
+    reg.observe_failover(endpoint=1, version=7)
+    reg.observe_round(expected=10, received=8, quorum=8, backups=1,
+                      deadline_hit=True)
+    snap = reg.snapshot()
+    assert snap["membership"]["epoch"] == 10
+    assert len(snap["membership"]["live"]) == 10
+    assert snap["counters"]["join"] == 10
+    assert snap["counters"]["failovers"] == 1
+    assert snap["rounds"][-1]["shortfall"] == 2
+    assert snap["rounds"][-1]["deadline_hit"] is True
+    assert len(snap["events"]) == 4     # bounded deque, newest kept
+    assert snap["events"][-1]["kind"] == "round"
+    # snapshot must be JSON-able (serving/metrics.py contract)
+    import json
+
+    json.dumps(snap)
+
+
+def test_registry_event_callback_fires():
+    seen = []
+    reg = HeartbeatRegistry(lease_s=5.0, clock=FakeClock(),
+                            on_event=seen.append)
+    reg.join("a")
+    reg.leave("a")
+    assert [e.kind for e in seen] == ["join", "leave"]
+
+
+# -- QuorumRunner ------------------------------------------------------------
+
+
+def _registry(**kw):
+    kw.setdefault("lease_s", 30.0)
+    return HeartbeatRegistry(**kw)
+
+
+def test_run_commits_every_partition_and_sets_task_context():
+    reg = _registry()
+    seen = {}
+
+    def task(it):
+        ctx = TaskContext.get()
+        seen[ctx.partitionId()] = (ctx.attemptNumber(), ctx.stageId())
+        yield sum(it)
+
+    runner = QuorumRunner(reg)
+    out = runner.run([[1, 2], [3, 4], [5, 6]], task, stage_id=9)
+    assert out == {0: [3], 1: [7], 2: [11]}
+    assert seen == {0: (0, 9), 1: (0, 9), 2: (0, 9)}
+    assert runner.backups_launched == 0 and runner.abandoned == []
+    assert reg.snapshot()["rounds"][-1]["shortfall"] == 0
+
+
+def test_transient_crash_is_retried_with_next_attempt_number():
+    reg = _registry()
+
+    def task(it):
+        ctx = TaskContext.get()
+        if ctx.partitionId() == 1 and ctx.attemptNumber() == 0:
+            raise RuntimeError("injected")
+        yield ctx.attemptNumber()
+
+    out = QuorumRunner(reg).run([[0], [0], [0]], task)
+    assert out == {0: [0], 1: [1], 2: [0]}
+
+
+def test_permanent_failure_expires_member_but_quorum_commits():
+    reg = _registry()
+
+    def task(it):
+        ctx = TaskContext.get()
+        if ctx.partitionId() == 2:
+            raise RuntimeError("always down")
+        yield "ok"
+
+    runner = QuorumRunner(reg, quorum=2, max_failures=3)
+    out = runner.run([[0], [0], [0]], task)
+    assert sorted(out) == [0, 1]
+    assert not reg.is_live(member_id_for(2))    # declared dead, fenced
+    assert reg.fence(member_id_for(2)) > 0
+    assert reg.snapshot()["rounds"][-1]["received"] == 2
+
+
+def test_quorum_lost_raises_once_too_few_can_report():
+    reg = _registry()
+
+    def task(it):
+        if TaskContext.get().partitionId() >= 1:
+            raise RuntimeError("down")
+        yield "ok"
+
+    with pytest.raises(QuorumLostError):
+        QuorumRunner(reg, quorum=3, max_failures=2).run([[0], [0], [0]], task)
+
+
+def test_round_deadline_commits_partial_and_abandons_the_rest():
+    reg = _registry()
+    release = threading.Event()
+
+    def task(it):
+        if TaskContext.get().partitionId() == 2:
+            release.wait(5.0)       # never finishes inside the deadline
+        yield "ok"
+
+    runner = QuorumRunner(reg, quorum=2, round_deadline_s=0.3)
+    try:
+        out = runner.run([[0], [0], [0]], task)
+    finally:
+        release.set()               # unblock the zombie thread
+    assert sorted(out) == [0, 1]
+    assert runner.abandoned == [2]
+    # the abandoned member was expired: its late result is stale by epoch
+    assert not reg.is_live(member_id_for(2))
+    assert reg.snapshot()["rounds"][-1]["deadline_hit"] is True
+
+
+def test_straggler_backup_first_finish_wins():
+    reg = _registry(straggler_after_s=0.15)
+    stalled = threading.Event()
+
+    def task(it):
+        ctx = TaskContext.get()
+        if ctx.partitionId() == 0 and ctx.attemptNumber() == 0:
+            stalled.wait(5.0)       # injected slow node, attempt 0 only
+        yield f"attempt-{ctx.attemptNumber()}"
+
+    runner = QuorumRunner(reg)
+    try:
+        out = runner.run([[0], [0]], task)
+    finally:
+        stalled.set()
+    # the backup clone (attempt 1) won the race; only ITS result committed
+    assert out[0] == ["attempt-1"]
+    assert out[1] == ["attempt-0"]
+    assert runner.backups_launched == 1
+    counters = reg.snapshot()["counters"]
+    assert counters["backup"] == 1
+
+
+def test_late_result_after_deadline_commit_is_epoch_fenced():
+    """A task abandoned at the deadline eventually finishes: its queued
+    result must be rejected by the membership fence, never committed."""
+    reg = _registry()
+    release = threading.Event()
+    finished = threading.Event()
+
+    def task(it):
+        if TaskContext.get().partitionId() == 1:
+            release.wait(5.0)
+            finished.set()
+        yield "late"
+
+    runner = QuorumRunner(reg, quorum=1, round_deadline_s=0.2)
+    out = runner.run([[0], [0]], task)
+    assert sorted(out) == [0]
+    release.set()
+    assert finished.wait(5.0)
+    # launch epoch predates the expiry fence — exactly the stale-by-epoch
+    # condition the runner (and the async path's server fence) rejects
+    launched_at_most = 2            # both joins happened, nothing later
+    assert reg.fence(member_id_for(1)) > launched_at_most
+
+
+def test_unknown_member_result_paths_never_block_driver():
+    """Whole-round wall clock stays bounded by the slowest COMMITTED chain,
+    not by zombies: run() must return while the abandoned thread sleeps."""
+    reg = _registry()
+    release = threading.Event()
+
+    def task(it):
+        if TaskContext.get().partitionId() == 1:
+            release.wait(5.0)
+        yield "ok"
+
+    t0 = time.monotonic()
+    try:
+        QuorumRunner(reg, quorum=1, round_deadline_s=0.2).run([[0], [0]], task)
+    finally:
+        elapsed = time.monotonic() - t0
+        release.set()
+    assert elapsed < 3.0

@@ -189,6 +189,43 @@ def test_breaker_half_open_admits_single_probe():
     assert cb.allow()
 
 
+def test_breaker_half_open_concurrent_arbitration():
+    """N threads hit a just-half-opened breaker simultaneously: exactly ONE
+    must win the probe slot — a thundering herd of probes against a barely
+    recovered server is what half-open exists to prevent. Repeated across
+    rounds (with the probe failing in between) to shake out lost-update
+    races on the ``_probing`` flag."""
+    import threading
+
+    clock = FakeClock()
+    cb = CircuitBreaker(failure_threshold=1, reset_timeout_s=1.0,
+                        clock=clock)
+    n_threads = 16
+    for round_ in range(5):
+        cb.record_failure()                  # (re)open the breaker
+        clock.t += 2.0                       # past the reset window
+        barrier = threading.Barrier(n_threads)
+        admitted = []
+        lock = threading.Lock()
+
+        def contend():
+            barrier.wait()
+            ok = cb.allow()
+            with lock:
+                admitted.append(ok)
+
+        threads = [threading.Thread(target=contend)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert admitted.count(True) == 1, \
+            f"round {round_}: {admitted.count(True)} probes admitted"
+        cb.record_failure()                  # the probe failed: back to open
+        assert cb.state == CircuitBreaker.OPEN
+
+
 def test_resilient_client_rides_through_flaky_wire():
     inner = FlakyClient(fail_pulls=2, fail_pushes=1)
     client = ResilientClient(inner, policy=_policy(max_attempts=5))

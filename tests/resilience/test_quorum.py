@@ -1,0 +1,116 @@
+"""Pinned elastic-quorum scenarios (ISSUE acceptance criteria, scenario b):
+with 2 of 8 workers crashed by a seeded FaultPlan and quorum K=6, synchronous
+training must complete on the surviving subset with a finite, decreasing
+loss. Plus the fused-program analog: an expired member is masked out of the
+merge via ``worker_valid`` without recompiling the executable."""
+
+import numpy as np
+import pytest
+
+from elephas_tpu import SparkModel
+from elephas_tpu.resilience import (
+    FaultPlan, HeartbeatRegistry, QuorumLostError,
+)
+from elephas_tpu.utils import to_simple_rdd
+
+from ..conftest import make_classifier
+
+pytestmark = pytest.mark.resilience
+
+
+@pytest.fixture(scope="module")
+def quorum_data():
+    rng = np.random.default_rng(11)
+    n, d, c = 400, 10, 3            # 8 partitions x 50 samples (> batch 16)
+    x = rng.normal(size=(n, d)).astype("float32")
+    w = rng.normal(size=(d, c))
+    y = np.eye(c, dtype="float32")[(x @ w).argmax(axis=1)]
+    return x, y
+
+
+@pytest.mark.chaos
+def test_sync_quorum_commits_despite_two_dead_workers(spark_context,
+                                                      quorum_data):
+    """Scenario b pinned: partitions 2 and 5 crash on EVERY attempt (node
+    death, not a transient) — with quorum 6-of-8 the round must commit on
+    the received deltas, and training must still reduce the loss."""
+    x, y = quorum_data
+    model = make_classifier(hidden=8, optimizer="sgd")
+    loss_before = float(model.evaluate(x, y, verbose=0)[0])
+
+    plan = FaultPlan(seed=3, dead_partitions=[2, 5])
+    registry = HeartbeatRegistry(lease_s=120.0)
+    sm = SparkModel(model, mode="synchronous", num_workers=8, comm="host",
+                    fault_plan=plan, membership=registry, quorum=6)
+    sm.fit(to_simple_rdd(spark_context, x, y), epochs=1, batch_size=16,
+           verbose=0, validation_split=0.0, shuffle=False)
+
+    assert any(k.startswith("dead-partition-") for k in plan.fired), \
+        "the injected node deaths never fired"
+    final = model.get_weights()
+    for w in final:
+        assert np.all(np.isfinite(np.asarray(w)))
+    loss_after = float(model.evaluate(x, y, verbose=0)[0])
+    assert loss_after < loss_before
+
+    snap = sm.membership_snapshot()
+    round_ = snap["rounds"][-1]
+    assert round_["expected"] == 8
+    assert round_["received"] == 6
+    assert round_["quorum"] == 6
+    # the dead members were expired and fenced
+    assert "partition-2" not in snap["membership"]["live"]
+    assert "partition-5" not in snap["membership"]["live"]
+    assert snap["membership"]["fences"]["partition-2"] > 0
+
+
+@pytest.mark.chaos
+def test_sync_quorum_lost_raises(spark_context, quorum_data):
+    """With quorum == N, a permanently dead partition makes the round
+    impossible: the fit must fail loudly, not hang or silently commit."""
+    x, y = quorum_data
+    model = make_classifier(hidden=4, optimizer="sgd")
+    sm = SparkModel(model, mode="synchronous", num_workers=4, comm="host",
+                    fault_plan=FaultPlan(seed=0, dead_partitions=[1]),
+                    membership=HeartbeatRegistry(lease_s=120.0), quorum=4)
+    with pytest.raises(QuorumLostError):
+        sm.fit(to_simple_rdd(spark_context, x[:200], y[:200]), epochs=1,
+               batch_size=16, verbose=0, validation_split=0.0, shuffle=False)
+
+
+def test_jax_membership_mask_excludes_expired_worker(spark_context,
+                                                     quorum_data):
+    """Fused-program path: a member the registry saw die is masked out of
+    every merge denominator (engine ``worker_valid``), geometry unchanged."""
+    x, y = quorum_data
+    registry = HeartbeatRegistry(lease_s=120.0)
+    model = make_classifier(hidden=8, optimizer="sgd")
+    loss_before = float(model.evaluate(x, y, verbose=0)[0])
+    sm = SparkModel(model, mode="synchronous", num_workers=4, comm="jax",
+                    membership=registry, quorum=2)
+
+    # all members unknown-or-live: the mask collapses to None so the common
+    # case stays on the cached no-mask executable
+    assert sm._membership_mask(4) is None
+    registry.join("partition-3")
+    registry.expire("partition-3")
+    assert sm._membership_mask(4) == [1.0, 1.0, 1.0, 0.0]
+
+    sm.fit(to_simple_rdd(spark_context, x[:200], y[:200]), epochs=2,
+           batch_size=16, verbose=0, validation_split=0.0)
+    for w in model.get_weights():
+        assert np.all(np.isfinite(np.asarray(w)))
+    loss_after = float(model.evaluate(x[:200], y[:200], verbose=0)[0])
+    assert loss_after < loss_before
+
+
+def test_jax_membership_mask_quorum_lost():
+    registry = HeartbeatRegistry(lease_s=120.0)
+    model = make_classifier(hidden=4, optimizer="sgd")
+    sm = SparkModel(model, mode="synchronous", num_workers=4, comm="jax",
+                    membership=registry, quorum=3)
+    for pid in (1, 2):
+        registry.join(f"partition-{pid}")
+        registry.expire(f"partition-{pid}")
+    with pytest.raises(QuorumLostError):
+        sm._membership_mask(4)
