@@ -7,7 +7,7 @@
 TEST_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 KERAS_BACKEND=jax
 
-.PHONY: test test-fast test-chaos bench
+.PHONY: test test-fast test-chaos bench bench-serving
 
 test:
 	$(TEST_ENV) bash scripts/run_tests.sh -x -q
@@ -22,3 +22,11 @@ test-chaos:
 
 bench:
 	KERAS_BACKEND=jax python bench.py
+
+# Serving benches only: continuous batching vs sequential, then the fast
+# path (fused K-step decode vs single-step) at concurrency 1 and 8.
+bench-serving:
+	KERAS_BACKEND=jax python -c "import json, bench; \
+	r = {'serving': bench.bench_serving(3), \
+	     'serving_fastpath': bench.bench_serving_fastpath(3)}; \
+	print(json.dumps(r))"

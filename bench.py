@@ -427,6 +427,103 @@ def bench_serving(reps: int):
     }
 
 
+def bench_serving_fastpath(reps: int):
+    """Fused multi-token decode vs the single-step driver, steady state.
+
+    CPU-runnable. Measures the serving fast path's headline number: decode
+    tokens/sec AFTER all slots are admitted (prefill excluded — TTFT is
+    ``bench_serving``'s department), single-step (``fuse_k=1``) vs fused
+    (``fuse_k=K``, K decode steps per compiled dispatch), at concurrency 1
+    and 8. Fusion amortizes per-step dispatch overhead, which dominates
+    exactly when the per-step device work is small — so the slots=1 speedup
+    is the upper bound and slots=8 shows how much survives at batch width.
+    Greedy outputs are asserted token-identical between the two drivers, so
+    the speedup is never bought with different tokens.
+
+    The default geometry is deliberately SMALLER than ``bench_serving``'s
+    (d64/L2/V512): this bench measures dispatch amortization, and on the
+    CPU fallback the d256 model is compute-bound — per-step device time
+    swamps the per-step dispatch the fusion removes, reading ~1.0x and
+    saying nothing. The small model puts CPU in the same dispatch-bound
+    regime a TPU serving a per-token step is in. Skip with BENCH_SERVING=0;
+    geometry via BENCH_SERVE_FAST_{DMODEL,LAYERS,VOCAB,NEW} plus the shared
+    BENCH_SERVE_PROMPT, and BENCH_SERVE_FUSE for K.
+    """
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    if os.environ.get("BENCH_SERVING", "1") == "0":
+        log("serving fastpath bench: skipped (BENCH_SERVING=0)")
+        return None
+
+    from elephas_tpu.models import TransformerLM
+    from elephas_tpu.serving import ServingEngine
+
+    def knob(name, default):
+        return int(os.environ.get(f"BENCH_SERVE_{name.upper()}", default))
+
+    d_model = knob("fast_dmodel", 64)
+    n_layers = knob("fast_layers", 2)
+    n_heads = max(1, d_model // 64)
+    vocab = knob("fast_vocab", 512)
+    prompt_len = knob("prompt", 16)
+    max_new = knob("fast_new", 64)
+    fuse_k = knob("fuse", 8)
+    model = TransformerLM(
+        vocab=vocab, d_model=d_model, n_heads=n_heads, n_layers=n_layers,
+        d_ff=4 * d_model, max_len=prompt_len + max_new,
+        pos_encoding="rotary", tie_embeddings=True,
+    )
+    params = {k: jnp.asarray(v) for k, v in model.init(seed=0).items()}
+
+    def steady_run(prompts, slots, k):
+        """Admit everything, then time decode-to-empty. Returns
+        (decode tokens/sec, per-request token lists)."""
+        eng = ServingEngine(model, params, n_slots=slots, fuse_k=k)
+        ids = [eng.submit(p, max_new) for p in prompts]
+        while eng.kv.free_slots:        # one prefill per step
+            eng.step()
+        t0 = time.perf_counter()
+        fin = eng.drain(max_steps=1_000_000)
+        dt = time.perf_counter() - t0
+        # each admitted request still owes max_new-1 decode tokens (the
+        # first came from the prefill logits before t0)
+        return len(prompts) * (max_new - 1) / dt, [fin[r].tokens for r in ids]
+
+    out = {"fuse_k": fuse_k}
+    for slots in (1, 8):
+        rng = np.random.default_rng(slots)
+        prompts = [rng.integers(0, vocab, size=(prompt_len,))
+                   .astype(np.int32) for _ in range(slots)]
+        log(f"serving fastpath: slots={slots} fuse_k={fuse_k} "
+            f"(compiling...)")
+        steady_run(prompts, slots, 1)           # warmup/compile both drivers
+        steady_run(prompts, slots, fuse_k)
+        best1, bestk, out1, outk = 0.0, 0.0, None, None
+        for rep in range(max(1, reps)):
+            r1, o1 = steady_run(prompts, slots, 1)
+            rk, ok = steady_run(prompts, slots, fuse_k)
+            log(f"serving fastpath rep {rep}: slots={slots} "
+                f"single {r1:,.0f} tok/s, fused {rk:,.0f} tok/s")
+            if r1 > best1:
+                best1, out1 = r1, o1
+            if rk > bestk:
+                bestk, outk = rk, ok
+        for got, want in zip(outk, out1):
+            np.testing.assert_array_equal(got, want)  # same tokens, faster
+        out[f"slots{slots}"] = {
+            "single_tok_s": round(best1, 1),
+            "fused_tok_s": round(bestk, 1),
+            "speedup": round(bestk / best1, 2),
+        }
+        log(f"serving fastpath: slots={slots} "
+            f"{out[f'slots{slots}']['speedup']:.2f}x fused speedup")
+    out["config"] = (f"d{d_model}xL{n_layers}xH{n_heads}-V{vocab}"
+                     f"-p{prompt_len}n{max_new}")
+    return out
+
+
 def bench_recovery(reps: int):
     """Checkpoint + auto-resume overhead vs an uninterrupted fit.
 
@@ -795,6 +892,16 @@ def main():
         serving = None
     if serving is not None:
         result["serving"] = serving
+        print(json.dumps(result), flush=True)
+
+    # -- serving fast path: fused decode vs single-step (CPU-runnable) ----
+    try:
+        fastpath = bench_serving_fastpath(reps)
+    except Exception as e:
+        log(f"serving fastpath bench failed: {type(e).__name__}: {e}")
+        fastpath = None
+    if fastpath is not None:
+        result["serving_fastpath"] = fastpath
         print(json.dumps(result), flush=True)
 
     # -- recovery phase: checkpoint + auto-resume tax (CPU-runnable) ------

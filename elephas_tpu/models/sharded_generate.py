@@ -384,14 +384,16 @@ def build_lm_generate(model: TransformerLM, mesh: Mesh,
 
 
 class ServingOps(NamedTuple):
-    """The sharded program pair the serving engine drives (plus the cache
+    """The sharded programs the serving engine drives (plus the cache
     factory matching their layout). Signatures are identical to the
     engine's single-device kernels, so ``ServingEngine`` swaps them in
-    without touching its loop."""
+    without touching its loop — including the chunked-prefill insert
+    (``pos0``) and the fused K-step decode."""
 
     init_cache: Any   # () -> {"k"/"v": [L, S, Hkv, capacity, Dh]} placed
-    insert: Any       # (params, cache, tokens[1,Tb], t_last, slot) -> (last[V], cache)
-    decode: Any       # (params, cache, tok[S], pos[S], temps[S], keys[S,2]) -> (tok[S], cache)
+    insert: Any       # (params, cache, tokens[1,Tb], t_last, slot, pos0) -> (last[V], cache)
+    decode: Any       # (params, cache, tok[S], pos[S], temps[S], keys[S,2], live[S]) -> (emit[S], tok, pos, cache)
+    decode_fused: Any  # (..., live[S], n_steps=K) -> (emit[S,K], tok, pos, cache)
     max_len: int
     capacity: int     # cache time axis = sp · aligned(ceil(max_len / sp))
 
@@ -404,25 +406,43 @@ def build_serving_ops(model: TransformerLM, mesh: Mesh, n_slots: int,
     per-chip cache memory drops by ``dp × sp`` while the driver loop stays
     the single-device one.
 
-    **Insert** mirrors ``_gen_impl``'s prefill-then-slice: the padded
-    prompt ``[1, Tb]`` prefills replicated into a FULL-capacity transient
-    K/V buffer (every seq rank then slices exactly ``[r·Tl, (r+1)·Tl)`` —
-    no clamping, so no aliasing case), and each data rank owner-masks the
+    **Insert** (``pos0 == 0``: a whole prompt, or a chunk train's FIRST
+    chunk) mirrors ``_gen_impl``'s prefill-then-slice: the padded prompt
+    ``[1, Tb]`` prefills replicated into a FULL-capacity transient K/V
+    buffer (every seq rank then slices exactly ``[r·Tl, (r+1)·Tl)`` — no
+    clamping, so no aliasing case), and each data rank owner-masks the
     write into its local slot row: the owner replaces the whole row, every
     other rank rewrites one of its rows with itself (statically shaped —
     the same trick as the decode step's owner write). Ranks past the
     prompt span write the transient buffer's zeros, wiping the previous
     occupant wholesale.
 
+    **Chunked insert** (``pos0 > 0``: a chunk train continuation) CANNOT
+    reuse that path — the chunk must attend the slot's existing sharded
+    K/V, and a transient-buffer rewrite would wipe it. Instead each rank
+    gathers its slice of the slot row, scatter-writes the chunk positions
+    that land in its slice (unique indices, out-of-slice and non-owner
+    writes drop), attends the chunk against the slice under the global
+    causal/window mask, and merges partials across ``"seq"`` by the same
+    logsumexp identity the decode step uses — just with matrix-matrix
+    score blocks instead of flash-decode. Non-owner data ranks compute on
+    a surrogate row and write nothing; the final logits replicate from
+    the owner by a masked ``psum`` over ``"data"``.
+
     **Decode** is ``_decode_step_sharded`` with PER-ROW positions (each
     slot at its own depth, free slots parked at 0) + per-slot selection;
     sampling runs replicated on every seq rank from identical merged
     logits and identical per-slot keys, so ranks stay in lockstep with no
     broadcast — ``row_offset`` folding is unnecessary because every slot
-    carries its own key.
+    carries its own key. The carry token/position advance in-program for
+    ``live`` rows (the engine's device-resident step state), and
+    **decode_fused** wraps the same body in a ``lax.scan`` of ``n_steps``
+    — one launch, K tokens, identical streams.
 
-    One decode program total; one insert program per prompt-length bucket
-    (``t_last``/``slot`` stay traced).
+    One decode program per fuse width; one insert program per
+    prompt-length bucket (``t_last``/``slot``/``pos0`` stay traced). The
+    cache is donated through every program so the sharded buffer updates
+    in place.
     """
     _check_mesh_and_specs(model, mesh)
     if model._ring_cache:
@@ -447,9 +467,12 @@ def build_serving_ops(model: TransformerLM, mesh: Mesh, n_slots: int,
     pspecs = model.specs()
 
     def init_cache():
-        z = jnp.zeros((L, n_slots, Hkv, capacity, Dh), cd)
+        # two DISTINCT buffers (the engine donates the cache through every
+        # program; XLA refuses aliased donations)
         sh = NamedSharding(mesh, cspec)
-        return {"k": jax.device_put(z, sh), "v": jax.device_put(z, sh)}
+        shape = (L, n_slots, Hkv, capacity, Dh)
+        return {"k": jax.device_put(jnp.zeros(shape, cd), sh),
+                "v": jax.device_put(jnp.zeros(shape, cd), sh)}
 
     def _insert_impl(params, cache, tokens, t_last, slot):
         # local cache [L, S_local, Hkv, Tl, Dh]; tokens [1, Tb] replicated
@@ -477,41 +500,229 @@ def build_serving_ops(model: TransformerLM, mesh: Mesh, n_slots: int,
                                             keepdims=False)
         return last, out
 
-    def _decode_impl(params, cache, tokens, pos, temps, keys):
-        # local: tokens/pos/temps [S_local], keys [S_local, 2]
+    def _chunk_impl(params, cache, tokens, t_last, slot, pos0):
+        # Chunk-train continuation: ``tokens`` [1, C] at absolute
+        # positions pos0.. against slot ``slot``'s EXISTING sharded row.
+        # Local cache [L, S_local, Hkv, Tl, Dh]; everything but the cache
+        # is replicated. See build_serving_ops' docstring for the shape
+        # of the computation.
+        S_local = cache["k"].shape[1]
+        C = tokens.shape[1]
+        H = model.n_heads
+        Hkv = model.n_kv_heads
+        Dh = model.d_model // H
+        cd = model.compute_dtype
+        r_seq = jax.lax.axis_index(SEQ_AXIS)
+        r_data = jax.lax.axis_index(DATA_AXIS)
+        slot_local = slot - r_data * S_local
+        own = (slot_local >= 0) & (slot_local < S_local)
+        idx = jnp.clip(slot_local, 0, S_local - 1)
+        # non-owner data ranks gather a surrogate row they write back
+        # unchanged (their chunk writes all drop below)
+        row = {n: jax.lax.dynamic_slice_in_dim(cache[n], idx, 1, axis=1)
+               for n in ("k", "v")}        # [L, 1, Hkv, Tl, Dh]
+
+        pos_b = pos0 + jnp.arange(C)[None, :]           # [1, C] absolute
+        h = model._embed(params, tokens, pos_b)         # [1, C, D]
+        rope = model._rope_for(pos_b)
+        # chunk→slice write coordinates: unique, consecutive; anything
+        # out of this rank's slice — or on a non-owner data rank — is
+        # redirected to Tl, which scatter mode="drop" discards (NEVER a
+        # negative index: numpy-style wrap would corrupt the slice tail)
+        local_t = pos_b[0] - r_seq * Tl                 # [C]
+        write_t = jnp.where((local_t >= 0) & (local_t < Tl) & own,
+                            local_t, Tl)
+        slots_g = r_seq * Tl + jnp.arange(Tl)           # [Tl] global pos
+
+        def mask_for(window):
+            # [1, C, Tl]: query i (global pos0+i) sees global slots
+            # <= its position, window-clamped below for this layer
+            m = slots_g[None, None, :] <= pos_b[:, :, None]
+            if window is not None:
+                m &= slots_g[None, None, :] > pos_b[:, :, None] - window
+            return m
+
+        def one_layer(h, lp, kc, vc, window):
+            # kc/vc [1, Hkv, Tl, Dh] — this rank's slice of the slot row
+            x = model._norm_h(lp, "ln1", h).astype(cd)
+            q = model._attn_proj(lp, "q", x).reshape(1, C, H, Dh)
+            k_new = model._attn_proj(lp, "k", x).reshape(1, C, Hkv, Dh)
+            v_new = model._attn_proj(lp, "v", x).reshape(1, C, Hkv, Dh)
+            if rope is not None:
+                q = _rope_rotate(q, *rope)
+                k_new = _rope_rotate(k_new, *rope)
+            kc = kc.at[:, :, write_t, :].set(
+                k_new.transpose(0, 2, 1, 3), mode="drop")
+            vc = vc.at[:, :, write_t, :].set(
+                v_new.transpose(0, 2, 1, 3), mode="drop")
+            # matrix-matrix scores against the local slice, then the
+            # logsumexp merge over "seq" (same identity as the decode
+            # step's flash-decode merge; exp(-inf)=0 drops masked slots,
+            # and the global max is finite — every query at least sees
+            # its own just-written position on its owner rank)
+            qg = q.transpose(0, 2, 1, 3).reshape(1, Hkv, H // Hkv, C, Dh)
+            scores = jnp.einsum(
+                "bkgsd,bktd->bkgst", qg, kc,
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            ) * (Dh ** -0.5)
+            scores = jnp.where(mask_for(window)[:, None, None], scores,
+                               -jnp.inf)
+            m_r = jnp.max(scores, axis=-1)              # [1, Hkv, G, C]
+            m = jax.lax.pmax(m_r, SEQ_AXIS)
+            w = jnp.exp(scores - m[..., None])
+            s_r = jnp.sum(w, axis=-1)
+            o_r = jnp.einsum(
+                "bkgst,bktd->bkgsd", w, vc,
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            den = jax.lax.psum(s_r, SEQ_AXIS)
+            num = jax.lax.psum(o_r, SEQ_AXIS)
+            a = (num / den[..., None]).astype(cd)       # [1, Hkv, G, C, Dh]
+            a = a.reshape(1, H, C, Dh).transpose(0, 2, 1, 3)
+            h = h + model._attn_proj(lp, "o", a.reshape(1, C, model.d_model))
+            x = model._norm_h(lp, "ln2", h).astype(cd)
+            out, _ = model._ffn(lp, x, "ring", SEQ_AXIS, ep_groups=1)
+            return h + out.astype(cd), kc, vc
+
+        pp = model._window_period()
+
+        def block(h, inputs):
+            lp, kc, vc = inputs
+            if pp == 1:
+                h, kc, vc = one_layer(h, lp, kc, vc, model.attn_windows[0])
+                return h, (kc, vc)
+            kcs, vcs = [], []
+            for g in range(pp):
+                h, kc_g, vc_g = one_layer(
+                    h, {k: v[g] for k, v in lp.items()}, kc[g], vc[g],
+                    model.attn_windows[g])
+                kcs.append(kc_g)
+                vcs.append(vc_g)
+            return h, (jnp.stack(kcs), jnp.stack(vcs))
+
+        lps = {k: params[k] for k in model._block_keys()}
+        ck, cv = row["k"], row["v"]
+        if pp > 1:
+            lps = _period_group(lps, pp)
+            ck = _period_group(ck, pp)
+            cv = _period_group(cv, pp)
+        h, (kc_new, vc_new) = jax.lax.scan(block, h, (lps, ck, cv))
+        if pp > 1:
+            kc_new = _period_ungroup(kc_new, model.n_layers)
+            vc_new = _period_ungroup(vc_new, model.n_layers)
+        h = model._norm_h(params, "lnf", h)
+        logits = model._logits(params, h)               # [1, C, V]
+        last = jax.lax.dynamic_index_in_dim(logits[0], t_last, axis=0,
+                                            keepdims=False)
+        # replicate the OWNER's logits (non-owner data ranks computed on
+        # surrogate rows — garbage h, masked out of the sum)
+        last = jax.lax.psum(jnp.where(own, last, 0.0), DATA_AXIS)
+        out = {}
+        for n, new in (("k", kc_new), ("v", vc_new)):
+            out[n] = jax.lax.dynamic_update_slice_in_dim(
+                cache[n], new, idx, axis=1)
+        return last, out
+
+    def _decode_impl(params, cache, tokens, pos, temps, keys, live):
+        # local: tokens/pos/temps/live [S_local], keys [S_local, 2]
         logits, kc, vc = _decode_step_sharded(
             model, params, tokens, pos, cache["k"], cache["v"], Tl)
-        toks = select_slot_tokens(logits, pos + 1, temps, keys)
-        return toks, {"k": kc, "v": vc}
+        emit = select_slot_tokens(logits, pos + 1, temps, keys)
+        tokens = jnp.where(live, emit, tokens)
+        pos = jnp.where(live, pos + 1, pos)
+        return emit, tokens, pos, {"k": kc, "v": vc}
+
+    def _fused_impl(n_steps, params, cache, tokens, pos, temps, keys, live):
+        def body(carry, _):
+            tok, p, kc, vc = carry
+            logits, kc, vc = _decode_step_sharded(
+                model, params, tok, p, kc, vc, Tl)
+            emit = select_slot_tokens(logits, p + 1, temps, keys)
+            tok = jnp.where(live, emit, tok)
+            p = jnp.where(live, p + 1, p)
+            return (tok, p, kc, vc), emit
+
+        (tokens, pos, kc, vc), emitted = jax.lax.scan(
+            body, (tokens, pos, cache["k"], cache["v"]), None,
+            length=n_steps)
+        return emitted.T, tokens, pos, {"k": kc, "v": vc}
 
     insert_programs: Dict[int, Any] = {}
+    chunk_programs: Dict[int, Any] = {}
 
-    def insert(params, cache, tokens, t_last, slot):
+    def insert(params, cache, tokens, t_last, slot, pos0=0):
         Tb = int(tokens.shape[1])
-        if Tb not in insert_programs:
-            insert_programs[Tb] = jax.jit(
+        if int(pos0) == 0:
+            # whole prompt, or a chunk train's first chunk: prefill-then-
+            # slice (also wipes the previous occupant wholesale)
+            if Tb not in insert_programs:
+                insert_programs[Tb] = jax.jit(
+                    shard_map(
+                        _insert_impl,
+                        mesh=mesh,
+                        in_specs=(pspecs, cache_specs, P(None, None), P(),
+                                  P()),
+                        out_specs=(P(), cache_specs),
+                        check_vma=False,
+                    ),
+                    donate_argnums=(1,),
+                )
+            return insert_programs[Tb](
+                params, cache, jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(t_last, jnp.int32), jnp.asarray(slot, jnp.int32))
+        if Tb not in chunk_programs:
+            chunk_programs[Tb] = jax.jit(
                 shard_map(
-                    _insert_impl,
+                    _chunk_impl,
                     mesh=mesh,
-                    in_specs=(pspecs, cache_specs, P(None, None), P(), P()),
+                    in_specs=(pspecs, cache_specs, P(None, None), P(), P(),
+                              P()),
                     out_specs=(P(), cache_specs),
                     check_vma=False,
-                )
+                ),
+                donate_argnums=(1,),
             )
-        return insert_programs[Tb](
+        return chunk_programs[Tb](
             params, cache, jnp.asarray(tokens, jnp.int32),
-            jnp.asarray(t_last, jnp.int32), jnp.asarray(slot, jnp.int32))
+            jnp.asarray(t_last, jnp.int32), jnp.asarray(slot, jnp.int32),
+            jnp.asarray(pos0, jnp.int32))
 
+    state_specs = (P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                   P(DATA_AXIS, None), P(DATA_AXIS))
     decode = jax.jit(
         shard_map(
             _decode_impl,
             mesh=mesh,
-            in_specs=(pspecs, cache_specs, P(DATA_AXIS), P(DATA_AXIS),
-                      P(DATA_AXIS), P(DATA_AXIS, None)),
-            out_specs=(P(DATA_AXIS), cache_specs),
+            in_specs=(pspecs, cache_specs) + state_specs,
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                       cache_specs),
             check_vma=False,
-        )
+        ),
+        donate_argnums=(1,),
     )
 
+    fused_programs: Dict[int, Any] = {}
+
+    def decode_fused(params, cache, tokens, pos, temps, keys, live,
+                     n_steps: int):
+        K = int(n_steps)
+        if K not in fused_programs:
+            fused_programs[K] = jax.jit(
+                shard_map(
+                    functools.partial(_fused_impl, K),
+                    mesh=mesh,
+                    in_specs=(pspecs, cache_specs) + state_specs,
+                    out_specs=(P(DATA_AXIS, None), P(DATA_AXIS),
+                               P(DATA_AXIS), cache_specs),
+                    check_vma=False,
+                ),
+                donate_argnums=(1,),
+            )
+        return fused_programs[K](params, cache, tokens, pos, temps, keys,
+                                 live)
+
     return ServingOps(init_cache=init_cache, insert=insert, decode=decode,
-                      max_len=max_len, capacity=capacity)
+                      decode_fused=decode_fused, max_len=max_len,
+                      capacity=capacity)

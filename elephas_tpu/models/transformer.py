@@ -1049,8 +1049,11 @@ class TransformerLM:
             T_req = min(T_req, self._max_window) + int(chunk)
         T = aligned_cache_length(T_req)
         shape = (L, batch, self.n_kv_heads, T, self.d_model // self.n_heads)
-        z = jnp.zeros(shape, self.compute_dtype)
-        return {"k": z, "v": z}
+        # two DISTINCT buffers: the serving kernels donate the cache, and
+        # XLA refuses to donate one buffer twice (`{"k": z, "v": z}` would
+        # alias them)
+        return {"k": jnp.zeros(shape, self.compute_dtype),
+                "v": jnp.zeros(shape, self.compute_dtype)}
 
     def prefill(self, params, tokens, cache, ffn_tag: str = "dense"):
         """Batched prompt ingestion: run the full (matrix-matrix) forward
@@ -1113,11 +1116,20 @@ class TransformerLM:
         h = self._norm_h(params, "lnf", h)
         return self._logits(params, h), cache
 
-    def prefill_slot(self, params, tokens, slot, cache):
+    def prefill_slot(self, params, tokens, slot, cache, pos0=0):
         """Prompt ingestion into ONE batch row of a multi-slot cache: run
         :meth:`decode_chunk` over ``tokens`` ``[1, T0]`` at positions
-        ``0..T0-1`` against slot ``slot``'s (traced int) rows of ``cache``
-        ``{"k"/"v": [L, S, Hkv, T, Dh]}`` → ``(logits [1, T0, V], cache)``.
+        ``pos0..pos0+T0-1`` against slot ``slot``'s (traced int) rows of
+        ``cache`` ``{"k"/"v": [L, S, Hkv, T, Dh]}`` →
+        ``(logits [1, T0, V], cache)``.
+
+        ``pos0`` (traced int, default 0) is the CHUNKED-prefill hook: a
+        long prompt lands as fixed-size chunks, each continuing where the
+        last stopped, with decode steps for other slots interleaved
+        between chunks (``serving/engine.py``). A chunk at ``pos0 > 0``
+        attends the slot's existing cache rows ``0..pos0-1`` plus its own
+        earlier positions — exactly what ``decode_chunk`` already
+        computes, so chunk boundaries cannot change the math.
 
         The serving engine's prefill-insert primitive
         (``serving/cache.py``): a new request lands in a free slot without
@@ -1140,7 +1152,8 @@ class TransformerLM:
                 "least one full-attention layer, or without slot batching"
             )
         slot_cache = cache_gather_slot(cache, slot)
-        logits, slot_cache = self.decode_chunk(params, tokens, 0, slot_cache)
+        logits, slot_cache = self.decode_chunk(params, tokens, pos0,
+                                               slot_cache)
         return logits, cache_scatter_slot(cache, slot, slot_cache)
 
     def decode_step(self, params, token, pos, cache):

@@ -195,6 +195,11 @@ class SocketClient(BaseParameterClient):
         self.timeout = float(timeout)
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        # per-client receive buffer: weight pulls land in one reused
+        # allocation instead of re-allocating a multi-MB payload per sync
+        # round (safe: all receives happen under _lock, and sockets.receive
+        # deserializes before returning)
+        self._rxbuf = socket_utils.ReusableBuffer()
         self.last_seen_version = -1
 
     def _ensure(self) -> socket.socket:
@@ -235,7 +240,7 @@ class SocketClient(BaseParameterClient):
     def get_parameters(self) -> List[np.ndarray]:
         def op(sock):
             sock.sendall(b"g")
-            return socket_utils.receive(sock)
+            return socket_utils.receive(sock, buf=self._rxbuf)
 
         with self._lock:
             return self._roundtrip(op)
@@ -243,7 +248,7 @@ class SocketClient(BaseParameterClient):
     def get_version(self) -> int:
         def op(sock):
             sock.sendall(b"v")
-            return int(socket_utils.receive(sock))
+            return int(socket_utils.receive(sock, buf=self._rxbuf))
 
         with self._lock:
             version = self._roundtrip(op)

@@ -459,6 +459,10 @@ class SocketServer(BaseParameterServer):
             self._conn_threads.append(t)
 
     def _action_listener(self, conn: socket.socket) -> None:
+        # one receive buffer per connection (each connection is serviced by
+        # exactly this thread): every push's multi-MB delta lands in the
+        # same reused allocation instead of a fresh one per round
+        rxbuf = socket_utils.ReusableBuffer()
         try:
             while not self._stop_event.is_set():
                 op = conn.recv(1)
@@ -467,16 +471,17 @@ class SocketServer(BaseParameterServer):
                 if op == b"g":
                     socket_utils.send(conn, self.get_weights())
                 elif op == b"u":
-                    delta = socket_utils.receive(conn)
+                    delta = socket_utils.receive(conn, buf=rxbuf)
                     self.apply_delta(delta)
                 elif op == b"t":
                     # tagged update: (task_id, delta) — exactly-once retries
-                    task_id, delta = socket_utils.receive(conn)
+                    task_id, delta = socket_utils.receive(conn, buf=rxbuf)
                     self.apply_delta(delta, task_id=task_id)
                 elif op == b"a":
                     # attempt-tagged update: (task_id, attempt, delta) —
                     # lets the server fence zombie attempts' pushes
-                    task_id, attempt, delta = socket_utils.receive(conn)
+                    task_id, attempt, delta = socket_utils.receive(
+                        conn, buf=rxbuf)
                     self.apply_delta(delta, task_id=task_id, attempt=attempt)
                 elif op == b"r":
                     # register (task_id, attempt); ack so the client can

@@ -48,13 +48,17 @@ def bucket_length(n: int, minimum: int = 8) -> int:
     return b
 
 
-@partial(jax.jit, static_argnames=("model",))
-def _insert_kernel(model, params, cache, tokens, t_last, slot):
+@partial(jax.jit, static_argnames=("model",), donate_argnums=(2,))
+def _insert_kernel(model, params, cache, tokens, t_last, slot, pos0):
     """Compiled prefill-insert: ``tokens`` ``[1, Tb]`` (bucket-padded) into
-    slot ``slot`` of ``cache``; returns (last real logits ``[V]`` f32,
-    cache). Keyed on (model, Tb) — ``t_last``/``slot`` stay traced so every
-    request in a bucket reuses one program."""
-    logits, cache = model.prefill_slot(params, tokens, slot, cache)
+    slot ``slot`` of ``cache`` starting at position ``pos0``; returns
+    (last real logits ``[V]`` f32, cache). Keyed on (model, Tb) —
+    ``t_last``/``slot``/``pos0`` stay traced so every request (and every
+    prefill CHUNK) in a bucket reuses one program. The cache is DONATED:
+    on accelerators the multi-GB buffer updates in place instead of being
+    copied (CPU silently ignores the hint)."""
+    logits, cache = model.prefill_slot(params, tokens, slot, cache,
+                                       pos0=pos0)
     last = jax.lax.dynamic_index_in_dim(logits[0], t_last, axis=0,
                                         keepdims=False)
     return last, cache
@@ -125,25 +129,35 @@ class SlotKVCache:
 
     # -- device ops ------------------------------------------------------
     def insert(self, slot: int, prompt: np.ndarray,
-               insert_fn=None) -> jnp.ndarray:
-        """Prefill ``prompt`` ``[T0]`` int into ``slot``; returns the
-        logits of the last REAL prompt position ``[V]`` (what the first
-        generated token is selected from). ``insert_fn`` overrides the
-        compiled kernel (the sharded engine passes its shard_map'd one
-        with the same ``(params, cache, tokens, t_last, slot) →
-        (last, cache)`` signature)."""
+               insert_fn=None, pos0: int = 0) -> jnp.ndarray:
+        """Prefill ``prompt`` ``[T0]`` int into ``slot`` at positions
+        ``pos0..pos0+T0-1``; returns the logits of the last REAL prompt
+        position ``[V]`` (what the first generated token is selected
+        from). ``pos0 > 0`` is a chunked-prefill continuation: the chunk
+        attends everything this slot already holds. ``insert_fn``
+        overrides the compiled kernel (the sharded engine passes its
+        shard_map'd one with the same ``(params, cache, tokens, t_last,
+        slot, pos0) → (last, cache)`` signature)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         T0 = prompt.shape[0]
+        pos0 = int(pos0)
         if not 1 <= T0 <= self.max_len:
             raise ValueError(f"prompt length {T0} not in [1, {self.max_len}]")
-        Tb = min(bucket_length(T0), self.capacity)
+        if not 0 <= pos0 <= self.max_len - T0:
+            raise ValueError(
+                f"pos0 {pos0} + chunk {T0} exceeds max_len {self.max_len}")
+        # bucket-pad, but never let the padded span run off the cache end:
+        # a clamped dynamic_update_slice would silently SHIFT the write
+        # left over live positions, which is worse than the extra program
+        # the odd trailing bucket costs
+        Tb = min(bucket_length(T0), self.capacity - pos0)
         padded = np.zeros((1, Tb), np.int32)
         padded[0, :T0] = prompt
         fn = insert_fn if insert_fn is not None else partial(
             _insert_kernel, self.model)
         last, self.cache = fn(self.params, self.cache, jnp.asarray(padded),
-                              T0 - 1, slot)
-        self.pos[slot] = T0
+                              T0 - 1, slot, pos0)
+        self.pos[slot] = pos0 + T0
         return last
 
     def advance(self, slot: int) -> None:

@@ -1,41 +1,72 @@
 """The continuous-batching driver loop.
 
-``ServingEngine`` is the host orchestrator over two compiled programs —
-one prefill-insert (per prompt-length bucket) and ONE batched decode step
-— multiplexing every in-flight request through them:
+``ServingEngine`` is the host orchestrator over a small set of compiled
+programs — prefill-insert (per prompt-length bucket), ONE batched decode
+step, and (fast path) a FUSED K-step decode — multiplexing every
+in-flight request through them:
 
     submit() ──▶ scheduler (bounded queue) ──▶ prefill into a free slot
                                                      │ first token
                                                      ▼
-                     one decode_step over ALL slots per step()
-                     (per-row positions; free slots ride along
-                      as pos-0 no-ops whose output is ignored)
-                                                     │ token per slot
+                     one decode program over ALL slots per step()
+                     (single step, or a fused ``lax.scan`` of K steps
+                      when the fast path engages; per-row positions;
+                      free slots ride along as no-op rows)
+                                                     │ token(s) per slot
                                                      ▼
                      EOS / length? → release slot → next queued request
 
-The decode batch is always the full ``[n_slots]`` geometry, so the decode
-program compiles ONCE: admission, completion, and reclaim never retrace.
-Free slots decode a dummy token at position 0 — the garbage K/V that
-writes is dead by the staleness-repair invariant (the next occupant's
-prefill overwrites it before anything attends it), and position 0 is the
-cheapest row a masked decode can run.
+The decode batch is always the full ``[n_slots]`` geometry, so each
+decode program compiles ONCE: admission, completion, and reclaim never
+retrace. Free slots decode a dummy token at position 0 — the garbage K/V
+that writes is dead by the staleness-repair invariant (the next
+occupant's prefill overwrites it before anything attends it), and
+position 0 is the cheapest row a masked decode can run.
+
+Three fast-path mechanisms (all OFF by default; every default-config
+behavior, including greedy/sampled token streams, is unchanged):
+
+- **Chunked prefill** (``prefill_chunk=``): a prompt longer than the
+  chunk size is inserted as fixed-size chunks interleaved with decode
+  steps, so co-batched requests see a bounded inter-token-latency bump
+  per chunk instead of one whole-prompt stall. A partially-prefilled
+  slot rides the decode batch as a non-live row parked AT ITS WRITE
+  HEAD: the garbage K/V each interleaved step writes there is exactly
+  what the next chunk overwrites.
+- **Fused multi-token decode** (``fuse_k=``): when no admission is
+  pending, no open chunk train, no live deadline, and every active slot
+  has ≥K budget left, K decode steps run inside ONE compiled
+  ``lax.scan`` program. Rows are independent and selection is keyed by
+  ``(seed, position)``, so the emitted streams are token-identical to K
+  single steps; the host truncates at EOS/budget afterward (the
+  post-EOS device writes are garbage the staleness-repair invariant
+  makes dead).
+- **Device-resident step state**: the per-slot carry token / position /
+  temperature / PRNG key / liveness live as device arrays the decode
+  kernels advance in place; the host touches them only through a tiny
+  jitted row-scatter at admission and release, instead of re-uploading
+  full mirrors every step. The KV cache is donated through every
+  kernel, so on accelerators the multi-GB buffer updates in place.
 
 Selection is per slot inside the compiled step
 (:func:`~elephas_tpu.models.transformer.select_slot_tokens`): greedy rows
 and sampled rows coexist in one batch, and a request's sample stream is
 keyed by ``(seed, position)`` — independent of slot assignment and of
 what else is co-batched, so results are reproducible under any
-interleaving. Greedy outputs are token-identical to per-request
-:meth:`TransformerLM.generate`.
+interleaving (and under any chunking or fusion). Greedy outputs are
+token-identical to per-request :meth:`TransformerLM.generate`.
 
-With ``mesh=`` the two programs come from
+With ``mesh=`` the programs come from
 :func:`~elephas_tpu.models.sharded_generate.build_serving_ops` instead:
 slots shard over ``"data"``, the KV cache time axis over ``"seq"``, and
-the driver loop here is UNCHANGED — the ops have the same signatures.
+the driver loop here is UNCHANGED — the ops have the same signatures,
+including the chunked insert and the fused decode.
 
 Time is injectable (``clock=``): latency tests pin exact TTFT/queue-wait
-numbers with a fake clock instead of sleeping.
+numbers with a fake clock instead of sleeping. The fast-path histograms
+(inter-token latency, dispatch overhead, chunk stalls) deliberately use
+``time.perf_counter`` instead — they measure wall clock, and reading the
+injectable clock for them would perturb fake-clock tests.
 """
 
 from __future__ import annotations
@@ -50,20 +81,57 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.transformer import select_slot_tokens
-from .cache import SlotKVCache
+from .cache import SlotKVCache, bucket_length
 from .metrics import RequestTiming, ServingMetrics
 from .scheduler import AdmissionError, Scheduler, ServingRequest
 
 
-@partial(jax.jit, static_argnames=("model",))
-def _decode_kernel(model, params, cache, tokens, pos, temps, keys):
+@partial(jax.jit, static_argnames=("model",), donate_argnums=(2,))
+def _decode_kernel(model, params, cache, tokens, pos, temps, keys, live):
     """One batched decode step over every slot + per-slot selection, as a
     single program: ``tokens``/``pos``/``temps`` ``[S]``, ``keys``
-    ``[S, 2]`` → ``(next tokens [S] int32, cache)``. ``pos`` is per-row —
-    exactly the batched-speculative form of ``decode_step`` — so slots at
-    wildly different depths advance together."""
+    ``[S, 2]``, ``live`` ``[S]`` bool → ``(emitted [S] int32, tokens,
+    pos, cache)``. ``pos`` is per-row — exactly the batched-speculative
+    form of ``decode_step`` — so slots at wildly different depths advance
+    together. The carry token/position advance IN the program (live rows
+    only), so the host never re-uploads them; the cache is donated."""
     logits, cache = model.decode_step(params, tokens, pos, cache)
-    return select_slot_tokens(logits, pos + 1, temps, keys), cache
+    emit = select_slot_tokens(logits, pos + 1, temps, keys)
+    tokens = jnp.where(live, emit, tokens)
+    pos = jnp.where(live, pos + 1, pos)
+    return emit, tokens, pos, cache
+
+
+@partial(jax.jit, static_argnames=("model", "n_steps"), donate_argnums=(2,))
+def _fused_decode_kernel(model, params, cache, tokens, pos, temps, keys,
+                         live, n_steps: int):
+    """``n_steps`` decode steps fused into ONE program (``lax.scan`` of
+    the single-step body): amortizes per-token dispatch overhead. Emits
+    every step's tokens ``[S, n_steps]``; non-live rows neither advance
+    nor change their carry (their emitted entries are garbage the host
+    ignores). Token-identical to ``n_steps`` single-step launches — rows
+    are independent and selection is ``(seed, position)``-keyed."""
+    def body(carry, _):
+        tok, p, cache = carry
+        logits, cache = model.decode_step(params, tok, p, cache)
+        emit = select_slot_tokens(logits, p + 1, temps, keys)
+        tok = jnp.where(live, emit, tok)
+        p = jnp.where(live, p + 1, p)
+        return (tok, p, cache), emit
+
+    (tokens, pos, cache), emitted = jax.lax.scan(
+        body, (tokens, pos, cache), None, length=n_steps)
+    return emitted.T, tokens, pos, cache
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
+def _scatter_row(tok, pos, temps, keys, live, slot, t, p, tmp, key, lv):
+    """Jitted single-row update of the device-resident step state (one
+    program — ``slot`` and the values stay traced). The five state
+    arrays are donated: a row scatter must not copy the batch."""
+    return (tok.at[slot].set(t), pos.at[slot].set(p),
+            temps.at[slot].set(tmp), keys.at[slot].set(key),
+            live.at[slot].set(lv))
 
 
 @jax.jit
@@ -90,19 +158,31 @@ class FinishedRequest:
 class ServingEngine:
     """Continuous-batching inference over one model: ``submit() →
     request_id``, ``step()`` (one scheduler action), ``drain()`` (run to
-    empty). See the module docstring for the loop shape."""
+    empty). See the module docstring for the loop shape and the
+    ``prefill_chunk`` / ``fuse_k`` fast-path knobs."""
 
     def __init__(self, model, params, n_slots: int = 8,
                  max_len: Optional[int] = None, max_queue: int = 64,
                  mesh=None, clock: Callable[[], float] = time.monotonic,
                  metrics_window: int = 1024, max_finished: int = 1024,
-                 fault_plan=None):
+                 fault_plan=None, prefill_chunk: Optional[int] = None,
+                 fuse_k: int = 1):
         if max_finished < 1:
             raise ValueError(f"max_finished must be >= 1, got {max_finished}")
+        if fuse_k < 1:
+            raise ValueError(f"fuse_k must be >= 1, got {fuse_k}")
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError(
+                f"prefill_chunk must be >= 1, got {prefill_chunk}")
         self.model = model
         self.params = params
         self.clock = clock
         self.max_finished = int(max_finished)
+        # chunk size rounds UP to the insert kernel's bucket grid so a
+        # full chunk is never padded (one compiled program per chunk)
+        self.prefill_chunk = (None if prefill_chunk is None
+                              else bucket_length(int(prefill_chunk)))
+        self.fuse_k = int(fuse_k)
         # resilience.FaultPlan (duck-typed): serving_stall(step_index)
         # seconds accumulate into _skew, which every engine-side clock read
         # adds on — a deterministic "this step took 30s" without sleeping,
@@ -116,22 +196,38 @@ class ServingEngine:
             self.kv = SlotKVCache(model, params, n_slots, max_len=max_len)
             self._insert_fn = None          # SlotKVCache's compiled default
             self._decode_fn = partial(_decode_kernel, model)
+            self._fused_fn = partial(_fused_decode_kernel, model)
+            state_shardings = [None] * 5
         else:
             # deferred import: sharded_generate is a heavier module and
             # this is the only place the local path would pull it in
+            from jax.sharding import NamedSharding, PartitionSpec as P
             from ..models.sharded_generate import build_serving_ops
+            from ..parallel.mesh import DATA_AXIS
             ops = build_serving_ops(model, mesh, n_slots,
                                     max_len=max_len)
             self.kv = SlotKVCache(model, params, n_slots,
                                   max_len=ops.max_len, cache=ops.init_cache())
             self._insert_fn = ops.insert
             self._decode_fn = ops.decode
-        # per-slot device-step inputs, mirrored host-side (tiny [S] arrays;
-        # the per-step host→device copies are noise next to the forward)
+            self._fused_fn = ops.decode_fused
+            row = NamedSharding(mesh, P(DATA_AXIS))
+            state_shardings = [row, row, row,
+                               NamedSharding(mesh, P(DATA_AXIS, None)), row]
+        # per-slot step state, DEVICE-resident: the decode kernels advance
+        # it in place; the host writes single rows through _scatter_row at
+        # admission/release instead of re-uploading [S] mirrors every step
         S = self.kv.n_slots
-        self._tok = np.zeros(S, np.int32)       # carry token per slot
-        self._temps = np.zeros(S, np.float32)   # <=0 ⇒ greedy row
-        self._keys = np.zeros((S, 2), np.uint32)
+        init = (jnp.zeros(S, jnp.int32),        # carry token per slot
+                jnp.zeros(S, jnp.int32),        # write-head position
+                jnp.zeros(S, jnp.float32),      # <=0 ⇒ greedy row
+                jnp.zeros((S, 2), jnp.uint32),  # PRNG key per slot
+                jnp.zeros(S, bool))             # live (advancing) row?
+        (self._tok, self._pos, self._temps, self._keys, self._live) = (
+            a if sh is None else jax.device_put(a, sh)
+            for a, sh in zip(init, state_shardings))
+        self._partial: Optional[ServingRequest] = None  # open chunk train
+        self._last_action: Optional[str] = None
         self._slot_req: Dict[int, ServingRequest] = {}
         self._requests: Dict[str, ServingRequest] = {}
         self._finished: Dict[str, FinishedRequest] = {}
@@ -201,23 +297,32 @@ class ServingEngine:
     # -- the loop --------------------------------------------------------
     def step(self) -> str:
         """Run ONE scheduler action — ``"prefill"`` (admit the next queued
-        request into a free slot and emit its first token), ``"decode"``
-        (one batched decode step over all slots), or ``"idle"`` — and
-        return which one ran. Expired deadlines are reaped first, so a
-        timed-out request frees its slot before this step's work is
-        chosen."""
+        request into a free slot), ``"prefill_chunk"`` (advance an open
+        chunked-prefill train), ``"decode"`` (one batched decode program
+        over all slots — a single step, or a fused K-step block when the
+        fast path engages), or ``"idle"`` — and return which one ran.
+        Expired deadlines are reaped first, so a timed-out request frees
+        its slot before this step's work is chosen."""
         if self.fault_plan is not None:
             self._skew += self.fault_plan.serving_stall(self._step_index)
         self._step_index += 1
         self._reap_expired()
-        action = self.scheduler.decide(self.kv.free_slots,
-                                       self.kv.active_slots)
+        # live decode rows only: a partially-prefilled slot is allocated
+        # but must not count as decodable (with no live rows its chunks
+        # run back-to-back instead of alternating with no-op decodes)
+        action = self.scheduler.decide(
+            self.kv.free_slots, len(self._slot_req),
+            has_partial=self._partial is not None,
+            last_action=self._last_action)
         if action == "prefill":
             req = self.scheduler.pop()
             if req is not None:
                 self._do_prefill(req)
+        elif action == "prefill_chunk":
+            self._do_prefill_chunk()
         elif action == "decode":
             self._do_decode()
+        self._last_action = action
         return action
 
     # -- early termination ------------------------------------------------
@@ -244,17 +349,17 @@ class ServingEngine:
         and file the terminal record. O(1): SlotKVCache.release is a
         free-list push (no cache rewrite — the staleness-repair invariant
         makes the dead rows harmless), and queued entries are tombstoned,
-        not re-heapified."""
+        not re-heapified. A mid-chunk-train request closes its train; its
+        partially-written prompt K/V is dead by the same invariant."""
         if req.slot is None:
             self.scheduler.discard(req)
         else:
             slot = req.slot
+            if req is self._partial:
+                self._partial = None
             self._slot_req.pop(slot, None)
             self.kv.release(slot)
-            # park the slot as a pos-0 greedy no-op row until reassigned
-            self._tok[slot] = 0
-            self._temps[slot] = 0.0
-            self._keys[slot] = 0
+            self._park(slot)
         self._requests.pop(req.request_id, None)
         req.timing.finished_at = self._now()
         req.timing.generated_tokens = len(req.generated)
@@ -302,38 +407,126 @@ class ServingEngine:
             active_slots=self.kv.active_slots,
             queue_depth=self.scheduler.queue_depth)
 
+    # -- device step state -------------------------------------------------
+    def _set_row(self, slot: int, tok: int, pos: int, temp: float,
+                 key, live: bool) -> None:
+        (self._tok, self._pos, self._temps, self._keys,
+         self._live) = _scatter_row(
+            self._tok, self._pos, self._temps, self._keys, self._live,
+            slot, tok, pos, temp, jnp.asarray(key, jnp.uint32), live)
+
+    def _park(self, slot: int) -> None:
+        """Return a slot's row to the free-rider configuration: greedy
+        no-op at position 0 whose output is ignored."""
+        self._set_row(slot, 0, 0, 0.0, np.zeros(2, np.uint32), False)
+
     # -- internals -------------------------------------------------------
     def _do_prefill(self, req: ServingRequest) -> None:
         slot = self.kv.allocate()
         req.timing.admitted_at = self._now()
-        last = self.kv.insert(slot, req.prompt, insert_fn=self._insert_fn)
+        req.slot = slot
         self.metrics.observe_prefill()
+        C = self.prefill_chunk
+        if C is not None and int(req.prompt.shape[0]) > C:
+            # long prompt: open a chunk train — first chunk now, the rest
+            # interleaved with decode by the scheduler
+            self._partial = req
+            self._do_prefill_chunk()
+            return
+        last = self.kv.insert(slot, req.prompt, insert_fn=self._insert_fn)
+        self._start_decoding(req, last)
+
+    def _do_prefill_chunk(self) -> None:
+        """Advance the open chunk train by one chunk; the FINAL chunk's
+        last real logits select the first token and the slot goes live."""
+        req = self._partial
+        T0 = int(req.prompt.shape[0])
+        start = req.prefill_pos
+        end = min(start + self.prefill_chunk, T0)
+        t0 = time.perf_counter()
+        last = self.kv.insert(req.slot, req.prompt[start:end],
+                              insert_fn=self._insert_fn, pos0=start)
+        last.block_until_ready()
+        self.metrics.observe_prefill_chunk(
+            end - start, len(self._slot_req), time.perf_counter() - t0)
+        req.prefill_pos = end
+        if end < T0:
+            # park the row non-live AT THE WRITE HEAD: the garbage K/V an
+            # interleaved decode step writes there lands exactly where the
+            # next chunk's insert overwrites it
+            self._set_row(req.slot, 0, end, 0.0, np.zeros(2, np.uint32),
+                          False)
+            return
+        self._partial = None
+        self._start_decoding(req, last)
+
+    def _start_decoding(self, req: ServingRequest, last) -> None:
+        """Shared admission tail: select the first token from the prompt's
+        last real logits, stamp timing, and make the slot a live decode
+        row."""
         T0 = int(req.prompt.shape[0])
         key = np.asarray(jax.random.PRNGKey(req.seed), np.uint32)
         tok = int(_select_first(last, T0, req.temperature,
                                 jnp.asarray(key)))
-        req.slot = slot
         req.next_pos = T0           # position `tok` occupies
         req.timing.first_token_at = self._now()
-        self._slot_req[slot] = req
-        self._tok[slot] = tok
-        self._temps[slot] = req.temperature
-        self._keys[slot] = key
+        self._slot_req[req.slot] = req
+        self._set_row(req.slot, tok, T0, req.temperature, key, True)
         self._emit(req, tok)
 
+    def _fuse_window(self) -> int:
+        """How many decode steps the next decode program may fuse (1 =
+        single-step driver). Fusion is bypassed whenever it could change
+        OBSERVABLE behavior beyond latency: an open chunk train (its
+        chunks must interleave), any live deadline (reaps are per-step
+        exact), a fault plan (injected stalls are per-step), or — when
+        work is queued — any active EOS-able request (an early-freed slot
+        must admit immediately, not up to K-1 steps late). The window is
+        clamped to the smallest remaining token budget, so budget
+        finishes land exactly on a block boundary."""
+        K = self.fuse_k
+        if (K < 2 or self.fault_plan is not None
+                or self._partial is not None or not self._slot_req):
+            return 1
+        if any(r.deadline_at is not None for r in self._requests.values()):
+            return 1
+        active = self._slot_req.values()
+        if self.scheduler.queue_depth and any(
+                r.eos_id is not None for r in active):
+            return 1
+        return max(1, min(K, min(r.max_new - len(r.generated)
+                                 for r in active)))
+
     def _do_decode(self) -> None:
-        n_active = self.kv.active_slots
-        toks, self.kv.cache = self._decode_fn(
-            self.params, self.kv.cache, jnp.asarray(self._tok),
-            jnp.asarray(self.kv.pos), jnp.asarray(self._temps),
-            jnp.asarray(self._keys))
-        self.metrics.observe_decode_step(n_active)
-        toks = np.asarray(toks)
+        n_active = len(self._slot_req)
+        K = self._fuse_window()
+        t0 = time.perf_counter()
+        if K == 1:
+            emit, self._tok, self._pos, self.kv.cache = self._decode_fn(
+                self.params, self.kv.cache, self._tok, self._pos,
+                self._temps, self._keys, self._live)
+            toks = np.asarray(emit).reshape(-1, 1)
+        else:
+            emit, self._tok, self._pos, self.kv.cache = self._fused_fn(
+                self.params, self.kv.cache, self._tok, self._pos,
+                self._temps, self._keys, self._live, n_steps=K)
+            toks = np.asarray(emit)             # [S, K]
+        t1 = time.perf_counter()
         for slot, req in list(self._slot_req.items()):
-            # this step WROTE each carry token's K/V at its position
-            self.kv.advance(slot)
-            req.next_pos += 1
-            self._emit(req, int(toks[slot]))
+            # consume this row's emitted tokens in order; stop at its
+            # finish (EOS/budget/cancel-from-callback) — the device kept
+            # decoding past it, but those writes are garbage the
+            # staleness-repair invariant already covers
+            for j in range(K):
+                if req.request_id not in self._requests:
+                    break
+                # this step WROTE each carry token's K/V at its position
+                self.kv.advance(slot)
+                req.next_pos += 1
+                self._emit(req, int(toks[slot, j]))
+        self.metrics.observe_decode_block(
+            n_active, K, block_s=t1 - t0,
+            host_s=time.perf_counter() - t1)
 
     def _emit(self, req: ServingRequest, tok: int) -> None:
         """Deliver one generated token: record, stream, finish/continue."""
@@ -344,8 +537,7 @@ class ServingEngine:
         if req.on_token is not None:
             req.on_token(req.request_id, tok, done)
         if not done:
-            self._tok[req.slot] = tok
-            return
+            return   # device carry already holds `tok` (kernel write-back)
         req.timing.finished_at = self._now()
         req.timing.generated_tokens = len(req.generated)
         req.timing.finish_reason = "eos" if done_eos else "length"
@@ -358,7 +550,4 @@ class ServingEngine:
         self._slot_req.pop(slot, None)
         self._requests.pop(req.request_id, None)
         self.kv.release(slot)
-        # park the slot as a pos-0 greedy no-op row until reassigned
-        self._tok[slot] = 0
-        self._temps[slot] = 0.0
-        self._keys[slot] = 0
+        self._park(slot)

@@ -87,8 +87,21 @@ class ServingMetrics:
     tokens_generated: int = 0
     prefills: int = 0
     decode_steps: int = 0
+    # fast-path counters: fused multi-token decode + chunked prefill.
+    # decode_steps counts LOGICAL steps (a fused block of K adds K), so
+    # occupancy and steady-state rates stay comparable across drivers.
+    fused_blocks: int = 0       # fused multi-step programs launched
+    fused_steps: int = 0        # logical steps covered by those blocks
+    prefill_chunks: int = 0     # chunk inserts (beyond whole-prompt ones)
     _occupancy_sum: float = 0.0  # Σ (active rows / slots) over decode steps
     _finished: Deque[RequestTiming] = field(default_factory=deque)
+    # wall-clock histograms (bounded deques, window entries each). These
+    # are measured with time.perf_counter by the engine, NEVER the
+    # injectable engine clock: fake-clock latency tests must not see
+    # extra clock reads, and dispatch overhead is a real-time quantity.
+    _itl: Deque[float] = field(default_factory=deque)       # s per token
+    _dispatch: Deque[float] = field(default_factory=deque)  # host s per token
+    _chunk_stall: Deque[float] = field(default_factory=deque)  # s per chunk
 
     def observe_reject(self, reason: str) -> None:
         self.rejected[reason] += 1
@@ -110,6 +123,40 @@ class ServingMetrics:
     def observe_decode_step(self, n_active: int) -> None:
         self.decode_steps += 1
         self._occupancy_sum += n_active / self.n_slots
+
+    def _push(self, dq: Deque[float], val: float) -> None:
+        dq.append(val)
+        while len(dq) > self.window:
+            dq.popleft()
+
+    def observe_decode_block(self, n_active: int, n_steps: int,
+                             block_s: Optional[float] = None,
+                             host_s: Optional[float] = None) -> None:
+        """One decode PROGRAM launch covering ``n_steps`` logical steps
+        (1 = the single-step driver; >1 = a fused block). ``block_s`` is
+        the wall-clock the program took (→ inter-token latency =
+        block_s / n_steps); ``host_s`` is the host-side time NOT spent
+        inside the device program (dispatch + python emit loop) — the
+        overhead fusion exists to amortize."""
+        for _ in range(int(n_steps)):
+            self.observe_decode_step(n_active)
+        if n_steps > 1:
+            self.fused_blocks += 1
+            self.fused_steps += int(n_steps)
+        if block_s is not None and n_steps > 0:
+            self._push(self._itl, block_s / n_steps)
+        if host_s is not None and n_steps > 0:
+            self._push(self._dispatch, host_s / n_steps)
+
+    def observe_prefill_chunk(self, n_tokens: int, stalled_slots: int,
+                              chunk_s: Optional[float] = None) -> None:
+        """One chunk insert of ``n_tokens`` while ``stalled_slots`` active
+        decode rows waited on it. The stall histogram records chunk
+        wall-clock ONLY when somebody actually stalled — it measures the
+        inter-token-latency spike chunking bounds, not prefill cost."""
+        self.prefill_chunks += 1
+        if chunk_s is not None and stalled_slots > 0:
+            self._push(self._chunk_stall, chunk_s)
 
     def observe_finish(self, timing: RequestTiming) -> None:
         self.completed += 1
@@ -164,6 +211,16 @@ class ServingMetrics:
                 "queue_wait_s": self._dist([t.queue_wait for t in fin]),
                 "decode_tokens_per_sec": self._dist(
                     [t.decode_tokens_per_sec for t in fin]),
+            },
+            # fast-path observability (its own section: the "engine" keys
+            # above are pinned exactly in tests and dashboards)
+            "fastpath": {
+                "fused_blocks": self.fused_blocks,
+                "fused_steps": self.fused_steps,
+                "prefill_chunks": self.prefill_chunks,
+                "inter_token_latency_s": self._dist(list(self._itl)),
+                "dispatch_overhead_s": self._dist(list(self._dispatch)),
+                "prefill_chunk_stall_s": self._dist(list(self._chunk_stall)),
             },
         }
 

@@ -62,6 +62,7 @@ class ServingRequest:
     slot: Optional[int] = None
     carry: Optional[int] = None    # last emitted token, not yet in cache
     next_pos: int = 0              # absolute position `carry` will occupy
+    prefill_pos: int = 0           # prompt tokens already inserted (chunked)
     generated: List[int] = field(default_factory=list)
 
 
@@ -122,9 +123,25 @@ class Scheduler:
             and now >= entry[2].deadline_at
         ]
 
-    def decide(self, free_slots: int, active_slots: int) -> str:
+    def decide(self, free_slots: int, active_slots: int,
+               has_partial: bool = False,
+               last_action: Optional[str] = None) -> str:
         """The next engine action: ``"prefill"`` (waiting work + a free
-        slot), else ``"decode"`` (any active slot), else ``"idle"``."""
+        slot), else ``"decode"`` (any active slot), else ``"idle"``.
+
+        With ``has_partial`` (a long prompt mid-chunked-prefill) the
+        choice is ``"prefill_chunk"`` ALTERNATED with ``"decode"``: the
+        chunk train makes progress every other step while the active
+        decode rows keep emitting — the bounded inter-token-latency
+        contract chunked prefill exists for. No NEW admission happens
+        while a partial is open (one prompt ingests at a time, so the
+        chunk kernel compiles per chunk bucket, not per concurrency
+        pattern); with no active rows the chunks just run back-to-back.
+        """
+        if has_partial:
+            if active_slots > 0 and last_action == "prefill_chunk":
+                return "decode"
+            return "prefill_chunk"
         if self._live and free_slots > 0:
             return "prefill"
         if active_slots > 0:

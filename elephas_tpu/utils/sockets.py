@@ -42,17 +42,48 @@ def determine_master(port: int = 4000) -> str:
     return f"{host}:{port}"
 
 
-def receive_all(sock: socket.socket, num_bytes: int) -> bytes:
-    """Read exactly ``num_bytes`` from ``sock`` (reference ``receive_all``)."""
-    chunks = []
-    remaining = num_bytes
-    while remaining > 0:
-        chunk = sock.recv(min(remaining, 1 << 20))
-        if not chunk:
+class ReusableBuffer:
+    """A grow-only receive buffer for :func:`receive_all` / :func:`receive`.
+
+    A weight pull deserializes a multi-MB payload every sync round; the
+    naive ``recv``-chunks-then-``join`` path allocates the payload twice
+    (chunk list + joined bytes) per round. Holding one of these per
+    connection lets ``recv_into`` land every round's payload in the SAME
+    allocation — it only grows, to the largest payload seen.
+
+    NOT thread-safe, and the memoryview handed out is only valid until the
+    next ``reserve`` — callers must finish deserializing before reusing.
+    ``SocketClient`` satisfies both by keeping one buffer per client under
+    its per-client lock.
+    """
+
+    def __init__(self, initial: int = 1 << 16):
+        self._buf = bytearray(initial)
+
+    def reserve(self, num_bytes: int) -> memoryview:
+        """A writable view of at least ``num_bytes`` (amortized growth)."""
+        if len(self._buf) < num_bytes:
+            self._buf = bytearray(max(num_bytes, 2 * len(self._buf)))
+        return memoryview(self._buf)
+
+
+def receive_all(sock: socket.socket, num_bytes: int,
+                buf: "ReusableBuffer | None" = None) -> bytes:
+    """Read exactly ``num_bytes`` from ``sock`` (reference ``receive_all``).
+
+    With ``buf`` the payload lands in the caller's reused allocation via
+    ``recv_into`` and a memoryview over it is returned (valid until the
+    buffer's next use); without, a fresh ``bytes`` is returned.
+    """
+    view = (memoryview(bytearray(num_bytes)) if buf is None
+            else buf.reserve(num_bytes)[:num_bytes])
+    got = 0
+    while got < num_bytes:
+        n = sock.recv_into(view[got:], min(num_bytes - got, 1 << 20))
+        if n == 0:
             raise ConnectionError("socket closed before full message received")
-        chunks.append(chunk)
-        remaining -= len(chunk)
-    return b"".join(chunks)
+        got += n
+    return bytes(view) if buf is None else view
 
 
 def send(sock: socket.socket, data: Any) -> None:
@@ -62,9 +93,13 @@ def send(sock: socket.socket, data: Any) -> None:
     sock.sendall(header + payload)
 
 
-def receive(sock: socket.socket) -> Any:
-    """Receive one framed pickled message (inverse of :func:`send`)."""
+def receive(sock: socket.socket, buf: "ReusableBuffer | None" = None) -> Any:
+    """Receive one framed pickled message (inverse of :func:`send`).
+
+    ``buf`` (a :class:`ReusableBuffer`) receives the payload in place —
+    the deserialized object is built before returning, so the buffer is
+    immediately reusable."""
     header = receive_all(sock, HEADER_WIDTH)
     length = int(header.decode("ascii"))
-    payload = receive_all(sock, length)
+    payload = receive_all(sock, length, buf=buf)
     return pickle.loads(payload)

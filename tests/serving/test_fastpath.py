@@ -1,0 +1,311 @@
+"""Serving fast path: chunked prefill, fused multi-token decode, and the
+device-resident step state behind them.
+
+The acceptance property for EVERY knob here is token identity: turning a
+fast-path feature on must not change a single emitted token — greedy or
+seeded-sampled, local or sharded — relative to the single-step
+whole-prefill driver (which is itself pinned against
+``TransformerLM.generate`` in test_engine.py)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from elephas_tpu.models.transformer import TransformerLM, build_mesh_sp
+from elephas_tpu.serving import ServingEngine
+from elephas_tpu.serving.scheduler import Scheduler
+
+pytestmark = pytest.mark.serving
+
+V = 17
+
+
+def _model(**kw):
+    cfg = dict(vocab=V, d_model=16, n_heads=4, n_layers=2, d_ff=32,
+               max_len=48)
+    cfg.update(kw)
+    return TransformerLM(**cfg)
+
+
+def _params(model, seed=1):
+    return {k: jnp.asarray(v) for k, v in model.init(seed=seed).items()}
+
+
+def _run(eng, reqs, **submit_kw):
+    """Submit ``(prompt, max_new)`` pairs interleaved with steps; drain;
+    return the token list per request in submission order."""
+    ids = []
+    for i, (prompt, max_new) in enumerate(reqs):
+        ids.append(eng.submit(prompt, max_new, seed=i, **submit_kw))
+        eng.step()
+    eng.drain(max_steps=5000)
+    return [eng.result(rid).tokens for rid in ids]
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        self.t += 1.0
+        return self.t
+
+
+def _prompts(rng, lens):
+    return [rng.integers(0, V, size=(n,)).astype(np.int32) for n in lens]
+
+
+# -- chunked prefill ------------------------------------------------------
+
+def test_chunked_prefill_greedy_identity():
+    """Long prompts inserted as chunks (interleaved with live decodes)
+    emit the same greedy continuation as whole-prompt prefill AND as
+    per-request ``generate``."""
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(0)
+    prompts = _prompts(rng, [20, 3, 17, 9, 26])
+    reqs = [(p, 6) for p in prompts]
+
+    chunked = ServingEngine(model, params, n_slots=2, prefill_chunk=8)
+    got = _run(chunked, reqs)
+    assert chunked.snapshot()["fastpath"]["prefill_chunks"] >= 6
+
+    whole = ServingEngine(model, params, n_slots=2)
+    assert got == _run(whole, reqs)
+    for prompt, toks in zip(prompts, got):
+        ref = np.asarray(model.generate(params, prompt[None], 6))
+        assert toks == ref[0, len(prompt):].tolist()
+
+
+def test_chunked_prefill_sampled_identity():
+    """Seeded-sampled streams are (seed, position)-keyed, so chunk
+    boundaries cannot change them either."""
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(1)
+    reqs = [(p, 5) for p in _prompts(rng, [19, 11, 25])]
+    a = _run(ServingEngine(model, params, n_slots=2, prefill_chunk=8),
+             reqs, temperature=0.8)
+    b = _run(ServingEngine(model, params, n_slots=2), reqs, temperature=0.8)
+    assert a == b
+
+
+def test_chunked_prefill_sharded_identity():
+    """The dp×sp engine's chunk-insert program (existing-row logsumexp
+    merge) matches the local chunked engine and ``generate``."""
+    mesh = build_mesh_sp(data=2, seq=2)
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(2)
+    prompts = _prompts(rng, [21, 4, 18])
+    reqs = [(p, 5) for p in prompts]
+    eng = ServingEngine(model, params, n_slots=4, mesh=mesh,
+                        prefill_chunk=8)
+    got = _run(eng, reqs)
+    assert eng.snapshot()["fastpath"]["prefill_chunks"] >= 4
+    for prompt, toks in zip(prompts, got):
+        ref = np.asarray(model.generate(params, prompt[None], 5))
+        assert toks == ref[0, len(prompt):].tolist()
+
+
+def test_chunked_prefill_cancel_mid_train_frees_slot():
+    """Cancelling a request mid-chunk-train closes the train, frees the
+    slot, and leaves co-batched streams untouched."""
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(3)
+    short, long = _prompts(rng, [3, 26])
+
+    eng = ServingEngine(model, params, n_slots=2, prefill_chunk=8)
+    rid_s = eng.submit(short, 8)
+    eng.step()                          # admit short → live
+    rid_l = eng.submit(long, 4)
+    eng.step()                          # admit long → first chunk only
+    assert eng._partial is not None
+    assert eng.cancel(rid_l)
+    assert eng._partial is None
+    assert eng.kv.free_slots == 1       # slot reclaimed immediately
+    eng.drain(max_steps=500)
+    assert eng.result(rid_l).finish_reason == "cancelled"
+    ref = np.asarray(model.generate(params, short[None], 8))
+    assert eng.result(rid_s).tokens == ref[0, len(short):].tolist()
+
+
+def test_scheduler_interleaves_chunks_with_decode():
+    """With a live decode row, an open chunk train alternates
+    prefill_chunk/decode; with none, chunks run back-to-back."""
+    s = Scheduler()
+    assert s.decide(1, 1, has_partial=True, last_action=None) \
+        == "prefill_chunk"
+    assert s.decide(1, 1, has_partial=True, last_action="prefill_chunk") \
+        == "decode"
+    assert s.decide(1, 1, has_partial=True, last_action="decode") \
+        == "prefill_chunk"
+    assert s.decide(1, 0, has_partial=True, last_action="prefill_chunk") \
+        == "prefill_chunk"
+    # and the legacy positional form still drives the non-chunked loop
+    assert s.decide(1, 1) == "decode"
+    assert s.decide(0, 0) == "idle"
+
+
+# -- fused multi-token decode ---------------------------------------------
+
+def test_fused_decode_greedy_identity():
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(4)
+    prompts = _prompts(rng, [5, 9, 3, 12, 7])
+    reqs = [(p, 9) for p in prompts]
+
+    fused = ServingEngine(model, params, n_slots=4, fuse_k=4)
+    got = _run(fused, reqs)
+    assert fused.snapshot()["fastpath"]["fused_blocks"] > 0
+
+    assert got == _run(ServingEngine(model, params, n_slots=4), reqs)
+    for prompt, toks in zip(prompts, got):
+        ref = np.asarray(model.generate(params, prompt[None], 9))
+        assert toks == ref[0, len(prompt):].tolist()
+
+
+def test_fused_decode_sampled_identity():
+    """Fused blocks replay the exact per-(seed, position) sample stream
+    of K single steps."""
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(5)
+    reqs = [(p, 7) for p in _prompts(rng, [6, 10, 4])]
+    a = _run(ServingEngine(model, params, n_slots=2, fuse_k=3), reqs,
+             temperature=0.7)
+    b = _run(ServingEngine(model, params, n_slots=2), reqs,
+             temperature=0.7)
+    assert a == b
+
+
+def test_fused_decode_sharded_identity():
+    mesh = build_mesh_sp(data=2, seq=2)
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(6)
+    prompts = _prompts(rng, [5, 11, 8, 4])
+    reqs = [(p, 6) for p in prompts]
+    fused = ServingEngine(model, params, n_slots=4, mesh=mesh, fuse_k=3)
+    got = _run(fused, reqs)
+    assert fused.snapshot()["fastpath"]["fused_blocks"] > 0
+    for prompt, toks in zip(prompts, got):
+        ref = np.asarray(model.generate(params, prompt[None], 6))
+        assert toks == ref[0, len(prompt):].tolist()
+
+
+def test_fused_eos_truncation_exact():
+    """EOS inside a fused block: the host truncates the row's stream at
+    the EOS token — identical records to the single-step driver, which
+    stops the row the step it fires."""
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(7)
+    reqs = [(p, 12) for p in _prompts(rng, [4, 8, 6])]
+
+    fused_eng = ServingEngine(model, params, n_slots=4, fuse_k=4)
+    got = _run(fused_eng, reqs, eos_id=3)
+    ref = _run(ServingEngine(model, params, n_slots=4), reqs, eos_id=3)
+    assert got == ref
+    for toks in got:
+        assert 3 not in toks[:-1]       # EOS never mid-stream
+
+
+def test_fused_bypass_under_deadline_and_queue_pressure():
+    """Fusion must stand down whenever it could perturb observable
+    behavior: live deadlines (per-step reap exactness) and queued work
+    behind EOS-able actives (admission latency)."""
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(8)
+    p1, p2 = _prompts(rng, [4, 5])
+
+    eng = ServingEngine(model, params, n_slots=1, fuse_k=4,
+                        clock=FakeClock())
+    eng.submit(p1, 6, deadline_s=1e9)
+    eng.step()
+    eng.drain(max_steps=200)
+    assert eng.snapshot()["fastpath"]["fused_blocks"] == 0
+
+    eng2 = ServingEngine(model, params, n_slots=1, fuse_k=4)
+    eng2.submit(p1, 10, eos_id=3)       # EOS-able active...
+    eng2.step()
+    eng2.submit(p2, 2)                  # ...with work queued behind it
+    while eng2.scheduler.queue_depth:
+        eng2.step()
+        assert eng2.metrics.fused_blocks == 0
+    eng2.drain(max_steps=200)
+
+
+def test_deadline_reap_exact_with_fusion_enabled():
+    """A deadlined request under ``fuse_k>1`` produces the identical
+    terminal record the single-step driver does (fusion bypasses)."""
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(9)
+    (prompt,) = _prompts(rng, [4])
+
+    def run(**kw):
+        eng = ServingEngine(model, params, n_slots=1, clock=FakeClock(),
+                            **kw)
+        rid = eng.submit(prompt, 20, deadline_s=9.0)
+        eng.drain(max_steps=100)
+        fin = eng.result(rid)
+        return fin.finish_reason, fin.tokens
+
+    assert run(fuse_k=4) == run()
+
+
+def test_cancel_between_fused_blocks_exact():
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(10)
+    pa, pb = _prompts(rng, [5, 7])
+
+    def run(**kw):
+        eng = ServingEngine(model, params, n_slots=2, **kw)
+        ra = eng.submit(pa, 20)
+        rb = eng.submit(pb, 20)
+        for _ in range(4):
+            eng.step()
+        eng.cancel(ra)
+        eng.drain(max_steps=200)
+        return eng.result(ra).tokens, eng.result(rb).tokens
+
+    a4, b4 = run(fuse_k=4)
+    a1, b1 = run()
+    assert b4 == b1                     # survivor stream untouched
+    # cancel timing is counted in STEPS, and a fused step yields up to K
+    # tokens, so the streams may differ in length — but never in content:
+    # one must be a prefix of the other
+    n = min(len(a4), len(a1))
+    assert n > 0 and a4[:n] == a1[:n]
+
+
+def test_fused_smoke_and_metrics():
+    """CI tripwire (fast, CPU): the fused path must actually EXECUTE —
+    a regression that silently falls back to the single-step driver
+    fails here — and the fast-path histograms must populate and stay
+    JSON-able."""
+    import json
+
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(11)
+    eng = ServingEngine(model, params, n_slots=2, fuse_k=4,
+                        prefill_chunk=8)
+    reqs = [(p, 8) for p in _prompts(rng, [3, 20])]
+    _run(eng, reqs)
+    snap = json.loads(json.dumps(eng.snapshot()))
+    fp = snap["fastpath"]
+    assert fp["fused_blocks"] >= 1
+    assert fp["fused_steps"] >= 4
+    assert fp["prefill_chunks"] >= 2
+    assert fp["inter_token_latency_s"]["count"] > 0
+    assert fp["dispatch_overhead_s"]["count"] > 0
+    # decode_steps counts LOGICAL steps: fused blocks contribute K each
+    assert snap["engine"]["decode_steps"] >= fp["fused_steps"]
