@@ -7,7 +7,7 @@
 TEST_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 KERAS_BACKEND=jax
 
-.PHONY: test test-fast test-chaos bench bench-serving
+.PHONY: test test-fast test-chaos test-perf bench bench-serving bench-lm
 
 test:
 	$(TEST_ENV) bash scripts/run_tests.sh -x -q
@@ -20,6 +20,11 @@ test-fast:
 test-chaos:
 	ELEPHAS_TEST_GROUP=chaos $(TEST_ENV) bash scripts/run_tests.sh -x -q
 
+# Slow loss-trajectory parity sweeps for the train-step hot-path knobs
+# (overlap_grads / fused_apply / remat) — kept out of tier-1 by marker.
+test-perf:
+	ELEPHAS_TEST_GROUP=perf $(TEST_ENV) bash scripts/run_tests.sh -x -q
+
 bench:
 	KERAS_BACKEND=jax python bench.py
 
@@ -29,4 +34,14 @@ bench-serving:
 	KERAS_BACKEND=jax python -c "import json, bench; \
 	r = {'serving': bench.bench_serving(3), \
 	     'serving_fastpath': bench.bench_serving_fastpath(3)}; \
+	print(json.dumps(r))"
+
+# LM section only, forced on (BENCH_LM=1 runs it even off-TPU): the judged
+# geometry with per-phase timing (fwd_ms / bwd_reduce_ms / apply_ms /
+# reduce_block_ms) plus the overlap-on/off comparison. Override geometry
+# and knobs via BENCH_LM_* (e.g. BENCH_LM_OVERLAP=ring BENCH_LM_REMAT=dots).
+bench-lm:
+	BENCH_LM=1 KERAS_BACKEND=jax python -c "import json, bench; \
+	r = {'lm': bench.bench_lm(3), \
+	     'lm_overlap': bench.bench_lm_overlap(3)}; \
 	print(json.dumps(r))"

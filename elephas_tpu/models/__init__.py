@@ -15,7 +15,13 @@ from .pipeline_lm import (
     lm_pp_tp_specs,
 )
 from .losses import resolve_accuracy, resolve_per_sample_loss
-from .optimizers import adam_compact, scale_by_adam_compact, to_optax
+from .optimizers import (
+    FusedOptimizer,
+    adam_compact,
+    fused_adam,
+    scale_by_adam_compact,
+    to_optax,
+)
 from .lora import (
     LoRATensor,
     apply_lora,
@@ -45,10 +51,12 @@ from .transformer import (
     MoETransformerLM,
     TransformerLM,
     build_lm_eval_step,
+    build_lm_train_phases,
     build_lm_train_step,
     build_mesh_sp,
     chunked_summed_xent,
     make_lm_batches,
+    ring_psum,
     select_tokens,
     shard_lm_batch,
 )
@@ -82,7 +90,9 @@ __all__ = [
     "load_hf_lm",
     "resolve_per_sample_loss",
     "resolve_accuracy",
+    "FusedOptimizer",
     "adam_compact",
+    "fused_adam",
     "scale_by_adam_compact",
     "to_optax",
     "build_lm_generate",
@@ -97,8 +107,10 @@ __all__ = [
     "MoETransformerLM",
     "build_mesh_sp",
     "build_lm_train_step",
+    "build_lm_train_phases",
     "build_lm_eval_step",
     "chunked_summed_xent",
     "make_lm_batches",
+    "ring_psum",
     "shard_lm_batch",
 ]

@@ -11,11 +11,29 @@ hyperparameters and update rules.
 from __future__ import annotations
 
 import warnings
-from typing import Any, Tuple
+from typing import Any, Callable, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
 import optax
+
+
+class FusedOptimizer(NamedTuple):
+    """An ``optax.GradientTransformation`` (same ``init``/``update`` duck
+    type — every consumer accesses those by attribute) plus a
+    ``fused_apply(grads, opt_state, params) -> (params, opt_state)`` path
+    that collapses the update math AND the dtype-preserving parameter apply
+    into one expression per param leaf. XLA then fuses each leaf's moment
+    decay, bias correction, learning-rate scale, and ``p + u`` into a
+    single kernel: moments and params stream through VMEM once per step,
+    and the full ``updates`` tree never materializes in HBM. The state
+    tree is IDENTICAL to the unfused ``update`` path's, so checkpoints,
+    sharding-spec inference, and mixed fused/unfused trajectories all
+    interoperate."""
+
+    init: Callable
+    update: Callable
+    fused_apply: Callable
 
 
 def scale_by_adam_compact(
@@ -93,14 +111,75 @@ def adam_compact(
     b2: float = 0.999,
     eps: float = 1e-8,
     moment_dtype=jnp.bfloat16,
-) -> optax.GradientTransformation:
+) -> FusedOptimizer:
     """:func:`scale_by_adam_compact` chained with the learning-rate scale —
-    a drop-in for ``optax.adam`` with half the optimizer HBM."""
-    return optax.chain(
+    a drop-in for ``optax.adam`` with half the optimizer HBM.
+
+    Returns a :class:`FusedOptimizer`: ``.update`` is the classic two-pass
+    chain (adam scaling, then ``-lr``), ``.fused_apply`` performs the same
+    math PLUS the dtype-preserving ``p + u`` apply in one pass per leaf —
+    bit-identical to ``update`` followed by
+    ``(p + u).astype(p.dtype)`` (same op sequence, same f32
+    intermediates), pinned in ``tests/models/test_train_overlap.py``."""
+    chain = optax.chain(
         scale_by_adam_compact(b1=b1, b2=b2, eps=eps,
                               moment_dtype=moment_dtype),
         optax.scale(-float(learning_rate)),
     )
+    step_size = -float(learning_rate)
+    mdt = jnp.dtype(moment_dtype)
+
+    def fused_apply(grads, opt_state, params):
+        adam_state, scale_state = opt_state
+        count = adam_state.count + 1
+        c = count.astype(jnp.float32)
+        bc1 = 1.0 - jnp.power(jnp.float32(b1), c)
+        bc2 = 1.0 - jnp.power(jnp.float32(b2), c)
+
+        def one(g, m, v, p):
+            g32 = g.astype(jnp.float32)
+            m32 = b1 * m.astype(jnp.float32) + (1.0 - b1) * g32
+            v32 = b2 * v.astype(jnp.float32) + (1.0 - b2) * g32 * g32
+            u = step_size * ((m32 / bc1) / (jnp.sqrt(v32 / bc2) + eps))
+            return ((p + u).astype(p.dtype),
+                    m32.astype(mdt), v32.astype(mdt))
+
+        leaves_g, treedef = jax.tree_util.tree_flatten(grads)
+        leaves_m = treedef.flatten_up_to(adam_state.mu)
+        leaves_v = treedef.flatten_up_to(adam_state.nu)
+        leaves_p = treedef.flatten_up_to(params)
+        flat_p, flat_m, flat_v = [], [], []
+        for g, m, v, p in zip(leaves_g, leaves_m, leaves_v, leaves_p):
+            p2, m2, v2 = one(g, m, v, p)
+            flat_p.append(p2)
+            flat_m.append(m2)
+            flat_v.append(v2)
+        unflatten = jax.tree_util.tree_unflatten
+        return unflatten(treedef, flat_p), (
+            optax.ScaleByAdamState(
+                count=count,
+                mu=unflatten(treedef, flat_m),
+                nu=unflatten(treedef, flat_v),
+            ),
+            scale_state,
+        )
+
+    return FusedOptimizer(chain.init, chain.update, fused_apply)
+
+
+def fused_adam(
+    learning_rate: float = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+) -> FusedOptimizer:
+    """Plain Adam (full-precision f32 moments) with the fused
+    update+apply path — :func:`adam_compact` at ``moment_dtype=float32``,
+    where the compact storage casts are no-ops and only the fusion
+    remains. Use where ``optax.adam`` would be used but the train step
+    runs ``fused_apply=True``."""
+    return adam_compact(learning_rate, b1=b1, b2=b2, eps=eps,
+                        moment_dtype=jnp.float32)
 
 
 def _extract_lr(cfg: dict) -> float:

@@ -593,6 +593,91 @@ def _period_ungroup(arr, n_layers: int):
     return arr.reshape((n_layers,) + tuple(arr.shape[2:]))
 
 
+# Below this many elements a gradient leaf rides a plain ``psum``: the ring's
+# 2(P-1) nearest-neighbor hops only win once the payload amortizes their
+# launch latency (per-layer FFN/attention stacks qualify; norm scales don't).
+_RING_MIN_ELEMS = 65536
+
+
+def ring_psum(x, axis_name: str):
+    """All-reduce ``x`` over the named mesh axis as a ``ppermute`` ring —
+    reduce-scatter then all-gather, each ``P - 1`` nearest-neighbor hops of
+    ``size/P`` chunks — instead of one monolithic ``psum``.
+
+    Same sum as ``jax.lax.psum`` up to float reassociation (the chunks
+    accumulate around the ring rather than in XLA's reduction tree), so
+    use it where allclose-parity suffices, not bit-parity. Written against
+    the named axis only — no pmap, no mesh object — so it composes with
+    any ``shard_map``/GSPMD program that carries the axis. The chunked
+    form is what lets XLA overlap the hops with unrelated compute: each
+    hop is a small independent collective, not one axis-wide barrier.
+    """
+    n = axis_size(axis_name)
+    if n == 1:
+        return x
+    me = jax.lax.axis_index(axis_name)
+    flat = x.reshape(-1)
+    csz = -(-flat.size // n)
+    pad = csz * n - flat.size
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    chunks = flat.reshape(n, csz)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    take = lambda c, i: jax.lax.dynamic_index_in_dim(c, i, 0, keepdims=False)
+    put = lambda c, v, i: jax.lax.dynamic_update_index_in_dim(c, v, i, 0)
+    # reduce-scatter: after step s, chunk (me-s-1) mod n holds the partials
+    # of ranks {me-s-1, ..., me}; after n-1 steps rank me owns the COMPLETE
+    # chunk (me+1) mod n.
+    for s in range(n - 1):
+        buf = jax.lax.ppermute(take(chunks, (me - s) % n), axis_name, perm)
+        recv = (me - s - 1) % n
+        chunks = put(chunks, take(chunks, recv) + buf, recv)
+    # all-gather the completed chunks around the same ring.
+    for s in range(n - 1):
+        buf = jax.lax.ppermute(take(chunks, (me + 1 - s) % n), axis_name,
+                               perm)
+        chunks = put(chunks, buf, (me - s) % n)
+    out = chunks.reshape(-1)
+    if pad:
+        out = out[:x.size]
+    return out.reshape(x.shape)
+
+
+def _reduce_on_backward(reduce_ct):
+    """DrJAX-style broadcast/reduce pair as a custom-vjp identity tag:
+    forward passes the (param) tree through untouched; the backward applies
+    ``reduce_ct`` to the cotangent tree AT THE PROGRAM POINT where it is
+    produced. Wrapping each layer's param slice inside the block scan makes
+    that point "as soon as this layer's backward segment finishes" — the
+    per-bucket gradient collectives issue interleaved with the remaining
+    backward compute instead of as one serialized block after it, and the
+    latency-hiding scheduler can overlap them."""
+
+    @jax.custom_vjp
+    def tag(tree):
+        return tree
+
+    tag.defvjp(lambda tree: (tree, None), lambda _, ct: (reduce_ct(ct),))
+    return tag
+
+
+def _remat_wrap(fn, remat: str):
+    """Apply the block-scan remat policy: ``"none"`` stores all residuals
+    (the default — fastest when activations fit), ``"dots"`` saves matmul
+    outputs and recomputes the cheap elementwise/norm ops, ``"full"``
+    recomputes the whole block from its input (max memory relief; the
+    long-context companion to ``accum_steps``)."""
+    if remat == "none":
+        return fn
+    if remat == "dots":
+        return jax.checkpoint(
+            fn, prevent_cse=False,
+            policy=jax.checkpoint_policies.checkpoint_dots)
+    if remat == "full":
+        return jax.checkpoint(fn, prevent_cse=False)
+    raise ValueError(f"Unknown remat policy: {remat!r} (none|dots|full)")
+
+
 class TransformerLM:
     """Decoder-only LM: embed → L pre-norm blocks (attn + FFN) → norm → head.
 
@@ -824,20 +909,29 @@ class TransformerLM:
         return self.apply_with_aux(params, tokens, positions, attn, seq_axis)[0]
 
     def apply_with_aux(self, params: Dict[str, Any], tokens, positions,
-                       attn: str = "dense", seq_axis: str = SEQ_AXIS):
+                       attn: str = "dense", seq_axis: str = SEQ_AXIS,
+                       grad_reduce=None, remat: str = "none"):
         """Like :meth:`apply` but also returns the summed auxiliary loss
         (0.0 for the dense-FFN base model; the MoE variant's load-balancing
-        term)."""
+        term). ``grad_reduce``/``remat`` as in :meth:`apply_hidden`."""
         h, aux = self.apply_hidden(params, tokens, positions, attn,
-                                   seq_axis)
+                                   seq_axis, grad_reduce=grad_reduce,
+                                   remat=remat)
         return self._logits(params, h), aux
 
     def apply_hidden(self, params: Dict[str, Any], tokens, positions,
-                     attn: str = "dense", seq_axis: str = SEQ_AXIS):
+                     attn: str = "dense", seq_axis: str = SEQ_AXIS,
+                     grad_reduce=None, remat: str = "none"):
         """The forward up to (and including) the final norm — everything
         except the logits projection. Lets large-vocab losses stream the
         head (:func:`chunked_summed_xent`) instead of materializing
-        ``[B, T, V]``. Returns ``(h [B, T, D], aux)``."""
+        ``[B, T, V]``. Returns ``(h [B, T, D], aux)``.
+
+        ``grad_reduce`` (training only) wraps each scan step's layer-param
+        slice with a :func:`_reduce_on_backward` tag, so the per-layer
+        gradient collectives fire inside the scan's backward as each
+        segment completes; ``remat`` is the block-scan rematerialization
+        policy (:func:`_remat_wrap`)."""
         h = self._embed(params, tokens, positions)
         rope = self._rope_for(positions)
         # Fused-rope tables are built ONCE here — inside the scanned layer
@@ -861,6 +955,8 @@ class TransformerLM:
         def block(h, lps):
             # p sub-layers per scan step — each with ITS static window
             # (p == 1 for uniform models: the plain layer scan)
+            if grad_reduce is not None:
+                lps = grad_reduce(lps)
             aux_sum = jnp.asarray(0.0, jnp.float32)
             for g in range(p):
                 lp = {k: v[g] for k, v in lps.items()} if p > 1 else lps
@@ -873,7 +969,7 @@ class TransformerLM:
 
         if p > 1:
             stacks = _period_group(stacks, p)
-        h, auxes = jax.lax.scan(block, h, stacks)
+        h, auxes = jax.lax.scan(_remat_wrap(block, remat), h, stacks)
         h = self._norm_h(params, "lnf", h)
         return h, jnp.sum(auxes)
 
@@ -2010,38 +2106,27 @@ def _check_seq_len(model: TransformerLM, sp: int, t: int) -> None:
         )
 
 
-def build_lm_train_step(model: TransformerLM, mesh: Mesh, optimizer,
-                        attn: str = "ring", accum_steps: int = 1,
-                        vocab_block: Optional[int] = None):
-    """Compile one dp×sp (×ep for the MoE variant) LM training step.
-
-    ``vocab_block`` streams the loss head in that many vocab columns per
-    chunk (:func:`chunked_summed_xent`) so the ``[B, T, V]`` logits — and
-    their cotangent — never materialize; essential at the imported-
-    checkpoint vocab sizes (V = 32k–152k). ``None`` keeps the dense head.
-
-    Returns ``(step, opt_init)``: ``step(params, opt_state, tokens,
-    positions, targets) -> (params, opt_state, loss)`` with all three int
-    arrays ``[B, T]`` — batch dim sharded over ``"data"``, sequence dim over
-    ``"seq"``. Params and optimizer state follow ``model.specs()``: fully
-    replicated for the dense model; for :class:`MoETransformerLM` the expert
-    stacks (and their optimizer state) shard over ``"seq"`` and their
-    gradients skip the seq-axis psum (each seq rank owns its experts — the
-    all_to_all transpose already delivered their gradients locally).
-    ``loss`` is the optimized objective: token-mean CE plus the
-    ``aux_weight``-scaled load-balancing term (zero for the dense model).
-
-    ``accum_steps > 1`` runs gradient accumulation: the local batch splits
-    into that many microbatches, a ``lax.scan`` accumulates their gradients,
-    and ONE optimizer step applies the sum — activation memory drops to one
-    microbatch's worth (the long-context lever that composes with remat and
-    sequence parallelism). For the dense model the accumulated step is
-    mathematically identical to the full-batch step (pinned in tests); the
-    MoE variant routes each microbatch as its own dispatch group, so its
-    routing (not its math) differs from whole-batch routing.
-    """
+def _lm_step_parts(model: TransformerLM, mesh: Mesh, optimizer,
+                   attn: str, accum_steps: int, vocab_block: Optional[int],
+                   overlap_grads, fused_apply: bool, remat: str):
+    """Shared internals of :func:`build_lm_train_step` and
+    :func:`build_lm_train_phases`: validation, specs, and the per-phase
+    impl functions (forward objective, backward+reduction, the
+    post-backward reduce block, optimizer apply, and the fused whole
+    step), all written to run INSIDE the dp×sp ``shard_map``."""
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if overlap_grads not in (False, True, "ring"):
+        raise ValueError(
+            f"overlap_grads must be False, True, or 'ring', "
+            f"got {overlap_grads!r}")
+    if remat not in ("none", "dots", "full"):
+        raise ValueError(f"Unknown remat policy: {remat!r} (none|dots|full)")
+    if fused_apply and not hasattr(optimizer, "fused_apply"):
+        raise ValueError(
+            "fused_apply=True needs an optimizer exposing "
+            "fused_apply(grads, opt_state, params) — use adam_compact / "
+            "fused_adam from models/optimizers.py")
     sp = _validate_lm_step(model, mesh, attn)
     from ..parallel.param_utils import opt_state_specs
 
@@ -2059,91 +2144,281 @@ def build_lm_train_step(model: TransformerLM, mesh: Mesh, optimizer,
         return False
 
     seq_sharded = {k for k, s in pspecs.items() if _mentions_seq(s)}
-
     dp = mesh.shape[DATA_AXIS]
 
-    def step_impl(params, opt_state, tokens, positions, targets):
-        # token count is static, so normalization can live INSIDE the
-        # differentiated scalar: psum of per-shard objectives IS the global
-        # objective (the aux term is identical across a data group's seq
-        # ranks, so /(dp·sp) de-duplicates its sp copies).
-        ntok_total = float(tokens.shape[0] * tokens.shape[1] * dp * sp)
-
-        def loss_fn(p, tk, ps, tg):
-            # per-microbatch pieces SUM to the full-batch objective:
-            # CE is normalized by the global token count, the aux term
-            # additionally by accum_steps (it is a per-call mean).
-            if vocab_block is None:
-                logits, aux = model.apply_with_aux(p, tk, ps, attn=attn)
-                ce = _summed_xent(logits, tg)
-            else:
-                h, aux = model.apply_hidden(p, tk, ps, attn=attn)
-                ce = chunked_summed_xent(h, model.head_weight(p), tg,
-                                         vocab_block)
-            return ce / ntok_total + (
-                model.aux_weight / (dp * sp * accum_steps)
-            ) * aux
-
-        if accum_steps == 1:
-            objective, grads = jax.value_and_grad(loss_fn)(
-                params, tokens, positions, targets
-            )
-        else:
-            B = tokens.shape[0]
-            if B % accum_steps:
-                raise ValueError(
-                    f"local batch {B} not divisible by accum_steps "
-                    f"{accum_steps}"
-                )
-            micro = B // accum_steps
-            split = lambda a: a.reshape(accum_steps, micro, *a.shape[1:])
-
-            def body(carry, xs):
-                obj_acc, grad_acc = carry
-                tk, ps, tg = xs
-                obj, g = jax.value_and_grad(loss_fn)(params, tk, ps, tg)
-                return (
-                    obj_acc + obj,
-                    jax.tree_util.tree_map(jnp.add, grad_acc, g),
-                ), None
-
-            zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
-            (objective, grads), _ = jax.lax.scan(
-                body, (jnp.zeros((), jnp.float32), zeros),
-                (split(tokens), split(positions), split(targets)),
-            )
-        grads = {
+    def reduce_block(grads):
+        """The monolithic post-backward reduction (the baseline path): one
+        serialized psum block over every gradient leaf after the full
+        backward completes."""
+        return {
             k: jax.lax.psum(
                 g if k in seq_sharded else jax.lax.psum(g, SEQ_AXIS),
                 DATA_AXIS,
             )
             for k, g in grads.items()
         }
-        loss = jax.lax.psum(
-            jax.lax.psum(objective, SEQ_AXIS), DATA_AXIS
+
+    grad_reduce = None
+    if overlap_grads:
+        use_ring = overlap_grads == "ring"
+
+        def _axis_sum(g, axis):
+            if use_ring and g.size >= _RING_MIN_ELEMS:
+                return ring_psum(g, axis)
+            return jax.lax.psum(g, axis)
+
+        def _reduce_leaf(k, g):
+            if k not in seq_sharded:
+                g = _axis_sum(g, SEQ_AXIS)
+            return _axis_sum(g, DATA_AXIS)
+
+        grad_reduce = _reduce_on_backward(
+            lambda ct: {k: _reduce_leaf(k, g) for k, g in ct.items()})
+
+    # Non-block params (embeddings, final norm, untied head) are not part
+    # of the layer scan; under overlap their reduce-on-backward tag sits at
+    # the top of the loss so each cotangent's collective fires where AD
+    # produces it (the head/final-norm grads early in the backward — their
+    # psums overlap the entire block-scan backward).
+    top_keys = tuple(k for k in model.param_shapes()
+                     if k not in set(model._block_keys()))
+
+    def make_loss_fn(ntok_total):
+        def loss_fn(p, tk, ps, tg):
+            # per-microbatch pieces SUM to the full-batch objective:
+            # CE is normalized by the global token count, the aux term
+            # additionally by accum_steps (it is a per-call mean).
+            if grad_reduce is not None:
+                p = {**p, **grad_reduce({k: p[k] for k in top_keys})}
+            if vocab_block is None:
+                logits, aux = model.apply_with_aux(
+                    p, tk, ps, attn=attn, grad_reduce=grad_reduce,
+                    remat=remat)
+                ce = _summed_xent(logits, tg)
+            else:
+                h, aux = model.apply_hidden(
+                    p, tk, ps, attn=attn, grad_reduce=grad_reduce,
+                    remat=remat)
+                ce = chunked_summed_xent(h, model.head_weight(p), tg,
+                                         vocab_block)
+            return ce / ntok_total + (
+                model.aux_weight / (dp * sp * accum_steps)
+            ) * aux
+        return loss_fn
+
+    def _foreach_micro(fn, zero_carry, params, tokens, positions, targets):
+        """Run ``fn(params, tk, ps, tg)`` over the accum microbatches and
+        sum the results (one full-batch call at ``accum_steps == 1``)."""
+        if accum_steps == 1:
+            return fn(params, tokens, positions, targets)
+        B = tokens.shape[0]
+        if B % accum_steps:
+            raise ValueError(
+                f"local batch {B} not divisible by accum_steps "
+                f"{accum_steps}"
+            )
+        micro = B // accum_steps
+        split = lambda a: a.reshape(accum_steps, micro, *a.shape[1:])
+
+        def body(carry, xs):
+            out = fn(params, *xs)
+            return jax.tree_util.tree_map(jnp.add, carry, out), None
+
+        acc, _ = jax.lax.scan(
+            body, zero_carry,
+            (split(tokens), split(positions), split(targets)),
         )
+        return acc
+
+    def _ntok(tokens):
+        # token count is static, so normalization can live INSIDE the
+        # differentiated scalar: psum of per-shard objectives IS the global
+        # objective (the aux term is identical across a data group's seq
+        # ranks, so /(dp·sp) de-duplicates its sp copies).
+        return float(tokens.shape[0] * tokens.shape[1] * dp * sp)
+
+    def loss_impl(params, tokens, positions, targets):
+        """Forward-only objective (the ``fwd`` phase probe)."""
+        loss_fn = make_loss_fn(_ntok(tokens))
+        objective = _foreach_micro(loss_fn, jnp.zeros((), jnp.float32),
+                                   params, tokens, positions, targets)
+        return jax.lax.psum(jax.lax.psum(objective, SEQ_AXIS), DATA_AXIS)
+
+    def grad_impl(params, tokens, positions, targets):
+        """Backward including gradient reduction — in-scan collectives
+        under overlap, the post-backward :func:`reduce_block` otherwise.
+        Returns ``(objective, fully reduced grads)``."""
+        loss_fn = make_loss_fn(_ntok(tokens))
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        objective, grads = _foreach_micro(
+            jax.value_and_grad(loss_fn),
+            (jnp.zeros((), jnp.float32), zeros),
+            params, tokens, positions, targets)
+        if grad_reduce is None:
+            grads = reduce_block(grads)
+        return objective, grads
+
+    def apply_impl(params, opt_state, grads):
+        """Optimizer update + parameter apply (the ``apply`` phase)."""
+        if fused_apply:
+            return optimizer.fused_apply(grads, opt_state, params)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         # dtype-preserving apply: bf16-stored params add in f32 (updates
         # are f32 from the optimizer) and round ONCE; f32 params unchanged
         params = jax.tree_util.tree_map(
             lambda p, u: (p + u).astype(p.dtype), params, updates)
+        return params, opt_state
+
+    def step_impl(params, opt_state, tokens, positions, targets):
+        objective, grads = grad_impl(params, tokens, positions, targets)
+        loss = jax.lax.psum(
+            jax.lax.psum(objective, SEQ_AXIS), DATA_AXIS
+        )
+        params, opt_state = apply_impl(params, opt_state, grads)
         return params, opt_state, loss
 
+    return {
+        "sp": sp, "pspecs": pspecs, "sspecs": sspecs, "tok_spec": tok_spec,
+        "loss_impl": loss_impl, "grad_impl": grad_impl,
+        "reduce_block": None if overlap_grads else reduce_block,
+        "apply_impl": apply_impl, "step_impl": step_impl,
+    }
+
+
+def build_lm_train_step(model: TransformerLM, mesh: Mesh, optimizer,
+                        attn: str = "ring", accum_steps: int = 1,
+                        vocab_block: Optional[int] = None,
+                        overlap_grads=False, fused_apply: bool = False,
+                        remat: str = "none"):
+    """Compile one dp×sp (×ep for the MoE variant) LM training step.
+
+    ``vocab_block`` streams the loss head in that many vocab columns per
+    chunk (:func:`chunked_summed_xent`) so the ``[B, T, V]`` logits — and
+    their cotangent — never materialize; essential at the imported-
+    checkpoint vocab sizes (V = 32k–152k). ``None`` keeps the dense head.
+
+    Returns ``(step, opt_init)``: ``step(params, opt_state, tokens,
+    positions, targets) -> (params, opt_state, loss)`` with all three int
+    arrays ``[B, T]`` — batch dim sharded over ``"data"``, sequence dim over
+    ``"seq"``. Params and optimizer state follow ``model.specs()``: fully
+    replicated for the dense model; for :class:`MoETransformerLM` the expert
+    stacks (and their optimizer state) shard over ``"seq"`` and their
+    gradients skip the seq-axis sum (each seq rank owns its experts — the
+    all_to_all transpose already delivered their gradients locally).
+    ``loss`` is the optimized objective: token-mean CE plus the
+    ``aux_weight``-scaled load-balancing term (zero for the dense model).
+
+    ``accum_steps > 1`` runs gradient accumulation: the local batch splits
+    into that many microbatches, a ``lax.scan`` accumulates their gradients,
+    and ONE optimizer step applies the sum — activation memory drops to one
+    microbatch's worth (the long-context lever that composes with remat and
+    sequence parallelism). For the dense model the accumulated step is
+    mathematically identical to the full-batch step (pinned in tests); the
+    MoE variant routes each microbatch as its own dispatch group, so its
+    routing (not its math) differs from whole-batch routing.
+
+    Hot-path knobs (all off by default; token/loss parity pinned in
+    ``tests/models/test_train_overlap.py``):
+
+    - ``overlap_grads=True`` buckets the gradient reduction by LAYER
+      instead of firing one serialized psum block after the full backward:
+      each block-scan step's param slice carries a reduce-on-backward
+      custom-vjp tag (:func:`_reduce_on_backward`), so its seq/data
+      collectives issue as soon as that layer's backward segment produces
+      its cotangent and overlap the remaining backward compute.  Non-scan
+      params (embeddings, final norm, head) are tagged at the top of the
+      loss, which places the head/final-norm reductions BEFORE the block
+      backward in program order.  The psum placement is value-identical
+      (bit-identical at ``accum_steps=1``; with accumulation the
+      per-microbatch reduction reassociates the cross-device sum — allclose
+      parity, at ``accum_steps``× the communication volume).
+      ``overlap_grads="ring"`` additionally lowers large buckets
+      (≥ ``_RING_MIN_ELEMS`` elements) through :func:`ring_psum`'s chunked
+      ``ppermute`` ring instead of one monolithic psum.
+    - ``fused_apply=True`` collapses ``optimizer.update`` + the
+      dtype-preserving apply into one fused pass per param leaf
+      (``optimizer.fused_apply``) so moments and params stream through
+      VMEM once instead of materializing a full ``updates`` tree; needs a
+      fused-capable optimizer (``adam_compact``/``fused_adam``).
+    - ``remat="none"|"dots"|"full"`` sets the block-scan rematerialization
+      policy (:func:`_remat_wrap`).
+    """
+    parts = _lm_step_parts(model, mesh, optimizer, attn, accum_steps,
+                           vocab_block, overlap_grads, fused_apply, remat)
+    pspecs, sspecs, tok_spec = (parts["pspecs"], parts["sspecs"],
+                                parts["tok_spec"])
     jit_step = jax.jit(
         shard_map(
-            step_impl, mesh=mesh,
+            parts["step_impl"], mesh=mesh,
             in_specs=(pspecs, sspecs, tok_spec, tok_spec, tok_spec),
             out_specs=(pspecs, sspecs, P()),
             check_vma=False,
         ),
         donate_argnums=(0, 1),
     )
+    sp = parts["sp"]
 
     def step(params, opt_state, tokens, positions, targets):
         _check_seq_len(model, sp, tokens.shape[1])
         return jit_step(params, opt_state, tokens, positions, targets)
 
+    # Donation is verified at lowering (tests/models/test_donation.py);
+    # expose it so the guard doesn't pay backend compilation.
+    step.lower = jit_step.lower
     return step, make_opt_init(optimizer, mesh, sspecs)
+
+
+def build_lm_train_phases(model: TransformerLM, mesh: Mesh, optimizer,
+                          attn: str = "ring", accum_steps: int = 1,
+                          vocab_block: Optional[int] = None,
+                          overlap_grads=False, fused_apply: bool = False,
+                          remat: str = "none"):
+    """Per-phase probes mirroring :func:`build_lm_train_step`'s stages, so
+    a measured win is attributable (``bench.py``'s ``fwd_ms`` /
+    ``bwd_reduce_ms`` / ``apply_ms`` timing). Returns a dict of jitted
+    callables over the same shardings the step uses:
+
+    - ``"loss"(params, tokens, positions, targets) -> loss`` — forward
+      only.
+    - ``"grad"(params, ...) -> (loss, grads)`` — forward + backward +
+      gradient reduction (in-scan under ``overlap_grads``, the post-
+      backward block otherwise), so ``grad − loss`` times bwd+reduce.
+    - ``"reduce"(grads) -> grads`` — the standalone monolithic post-
+      backward psum block, or ``None`` under ``overlap_grads`` (the block
+      no longer exists in the step's profile — THE structural claim the
+      bench asserts on CPU, where MFU is meaningless).
+    - ``"apply"(params, opt_state, grads) -> (params, opt_state)`` — the
+      optimizer phase (fused or not). NOT donated: probes are re-invoked
+      on the same buffers for timing.
+    """
+    parts = _lm_step_parts(model, mesh, optimizer, attn, accum_steps,
+                           vocab_block, overlap_grads, fused_apply, remat)
+    pspecs, sspecs, tok_spec = (parts["pspecs"], parts["sspecs"],
+                                parts["tok_spec"])
+    three_tok = (tok_spec, tok_spec, tok_spec)
+    phases = {
+        "loss": jax.jit(shard_map(
+            parts["loss_impl"], mesh=mesh,
+            in_specs=(pspecs,) + three_tok, out_specs=P(),
+            check_vma=False)),
+        "grad": jax.jit(shard_map(
+            lambda p, tk, ps, tg: (
+                (lambda o, g: (jax.lax.psum(
+                    jax.lax.psum(o, SEQ_AXIS), DATA_AXIS), g))(
+                        *parts["grad_impl"](p, tk, ps, tg))),
+            mesh=mesh, in_specs=(pspecs,) + three_tok,
+            out_specs=(P(), pspecs), check_vma=False)),
+        "reduce": None,
+        "apply": jax.jit(shard_map(
+            parts["apply_impl"], mesh=mesh,
+            in_specs=(pspecs, sspecs, pspecs),
+            out_specs=(pspecs, sspecs), check_vma=False)),
+    }
+    if parts["reduce_block"] is not None:
+        phases["reduce"] = jax.jit(shard_map(
+            parts["reduce_block"], mesh=mesh,
+            in_specs=(pspecs,), out_specs=pspecs, check_vma=False))
+    return phases
 
 
 def build_lm_eval_step(model: TransformerLM, mesh: Mesh, attn: str = "ring"):

@@ -65,6 +65,11 @@ def _free_port() -> int:
 
 
 @pytest.mark.multihost
+@pytest.mark.xfail(
+    os.environ.get("JAX_PLATFORMS", "cpu") == "cpu",
+    strict=False,
+    reason="Multiprocess computations aren't implemented on the CPU backend",
+)
 def test_two_process_fit(tmp_path):
     port = _free_port()
     script = tmp_path / "worker.py"

@@ -1,0 +1,177 @@
+"""Loss-curve parity for the LM train-step hot-path knobs.
+
+``build_lm_train_step(overlap_grads=, fused_apply=, remat=)`` rebuilds the
+step's backward-reduction, optimizer-apply, and rematerialization layers;
+every variant must optimize the SAME objective as the baseline step. Pinned
+here:
+
+- **Bit-identity where the math is exactly associative**: at
+  ``accum_steps=1`` with ``remat="none"``, overlapped reduction moves each
+  psum to the program point its cotangent is produced WITHOUT changing its
+  operand, and the fused apply replays the unfused op sequence leaf-fused —
+  params after N steps are bit-identical, dense and MoE.
+- **Loss-trajectory allclose elsewhere**: accumulation reassociates the
+  per-microbatch cross-device sums (``Σ psum(g)`` vs ``psum(Σ g)``), the
+  ring lowers the reduction through a different summation order, and remat
+  recomputes the forward under different fusion — allclose on the loss
+  trajectory over ≥20 steps, NOT on raw params: adam's ``m/√v`` normalizer
+  amplifies float-noise-level gradient differences near small ``v``, so
+  param-space divergence is expected while the optimization trajectory
+  stays pinned (measured max relative loss drift ≤ 3e-4 over 25 steps on
+  this backend; asserted at 2e-3).
+
+The ≥20-step dense+MoE × accum ∈ {1,2} matrix required by the hot-path
+acceptance runs in tier-1; the wider combined-knob sweeps are marked
+``perf`` (+``slow``) — run them with ``make test-perf``.
+"""
+
+import numpy as np
+import pytest
+
+import optax
+
+from elephas_tpu.models import (
+    MoETransformerLM,
+    TransformerLM,
+    adam_compact,
+    build_lm_train_step,
+    build_mesh_sp,
+    make_lm_batches,
+    shard_lm_batch,
+)
+from elephas_tpu.models import transformer as transformer_mod
+
+perf = pytest.mark.perf
+slow = pytest.mark.slow
+
+LOSS_RTOL = 2e-3
+
+
+def _build(kind, accum=1, overlap=False, fused=False, remat="none",
+           optimizer=None):
+    mesh = build_mesh_sp(data=2, seq=2)
+    if kind == "moe":
+        model = MoETransformerLM(vocab=13, d_model=8, n_heads=2, n_layers=2,
+                                 d_ff=16, max_len=16, n_experts=2,
+                                 aux_weight=0.01)
+    else:
+        model = TransformerLM(vocab=13, d_model=8, n_heads=2, n_layers=2,
+                              d_ff=16, max_len=16)
+    optimizer = adam_compact(1e-2) if optimizer is None else optimizer
+    step, opt_init = build_lm_train_step(
+        model, mesh, optimizer, attn="ring", accum_steps=accum,
+        overlap_grads=overlap, fused_apply=fused, remat=remat,
+    )
+    rng = np.random.default_rng(0)
+    rows = rng.integers(0, 13, size=(8, 17))
+    batch = shard_lm_batch(mesh, *make_lm_batches(rows))
+    params = model.shard_params(mesh, model.init(seed=0))
+    return step, params, opt_init(params), batch
+
+
+def _trajectory(step, params, state, batch, steps):
+    losses = []
+    for _ in range(steps):
+        params, state, loss = step(params, state, *batch)
+        losses.append(float(loss))
+    return np.asarray(losses), {k: np.asarray(v) for k, v in params.items()}
+
+
+@pytest.mark.parametrize("kind", ["dense", "moe"])
+def test_overlap_fused_bit_identical_accum1(kind):
+    """accum=1, remat=none: the overlapped+fused step is EXACTLY the
+    baseline step — psums move, operands don't; the fused apply replays
+    the unfused op sequence. Params stay bit-identical over 5 steps."""
+    losses_b, params_b = _trajectory(*_build(kind), steps=5)
+    losses_o, params_o = _trajectory(
+        *_build(kind, overlap=True, fused=True), steps=5)
+    np.testing.assert_array_equal(losses_o, losses_b)
+    for k in params_b:
+        np.testing.assert_array_equal(params_o[k], params_b[k], err_msg=k)
+
+
+@pytest.mark.parametrize("kind", ["dense", "moe"])
+@pytest.mark.parametrize("accum", [1, 2])
+def test_overlap_fused_loss_parity(kind, accum):
+    """The required parity matrix: overlapped+fused matches the baseline
+    loss trajectory over 20 steps, dense and MoE, accum_steps ∈ {1, 2},
+    on the dp×sp mesh."""
+    losses_b, _ = _trajectory(*_build(kind, accum=accum), steps=20)
+    losses_o, _ = _trajectory(
+        *_build(kind, accum=accum, overlap=True, fused=True), steps=20)
+    np.testing.assert_allclose(losses_o, losses_b, rtol=LOSS_RTOL,
+                               atol=1e-5)
+
+
+def test_ring_reduction_loss_parity(monkeypatch):
+    """overlap_grads='ring' with the size threshold forced to 0 pushes
+    EVERY gradient leaf through the chunked ppermute ring; the summation
+    order differs from psum, so parity is allclose, not bitwise."""
+    monkeypatch.setattr(transformer_mod, "_RING_MIN_ELEMS", 1)
+    losses_b, _ = _trajectory(*_build("dense"), steps=20)
+    losses_r, _ = _trajectory(
+        *_build("dense", overlap="ring", fused=True), steps=20)
+    np.testing.assert_allclose(losses_r, losses_b, rtol=LOSS_RTOL,
+                               atol=1e-5)
+
+
+@pytest.mark.parametrize("remat", ["dots", "full"])
+def test_remat_loss_parity(remat):
+    """Remat recomputes the block forward (possibly under different XLA
+    fusion), so the first step must agree tightly and the trajectory
+    within the pinned tolerance."""
+    losses_b, _ = _trajectory(*_build("dense"), steps=20)
+    losses_r, _ = _trajectory(*_build("dense", remat=remat), steps=20)
+    np.testing.assert_allclose(losses_r[0], losses_b[0], rtol=1e-5)
+    np.testing.assert_allclose(losses_r, losses_b, rtol=5e-3, atol=1e-5)
+
+
+def test_fused_apply_matches_unfused_chain():
+    """fused_apply alone (no overlap) is bit-identical to update+apply —
+    the optimizer-level contract, independent of the reduction layout."""
+    losses_b, params_b = _trajectory(*_build("dense"), steps=5)
+    losses_f, params_f = _trajectory(*_build("dense", fused=True), steps=5)
+    np.testing.assert_array_equal(losses_f, losses_b)
+    for k in params_b:
+        np.testing.assert_array_equal(params_f[k], params_b[k], err_msg=k)
+
+
+def test_knob_validation():
+    mesh = build_mesh_sp(data=2, seq=2)
+    model = TransformerLM(vocab=13, d_model=8, n_heads=2, n_layers=1,
+                          d_ff=16, max_len=16)
+    with pytest.raises(ValueError, match="fused_apply"):
+        build_lm_train_step(model, mesh, optax.adam(1e-2), fused_apply=True)
+    with pytest.raises(ValueError, match="remat"):
+        build_lm_train_step(model, mesh, adam_compact(1e-2), remat="dotz")
+    with pytest.raises(ValueError, match="overlap_grads"):
+        build_lm_train_step(model, mesh, adam_compact(1e-2),
+                            overlap_grads="rings")
+
+
+@perf
+@slow
+@pytest.mark.parametrize("kind", ["dense", "moe"])
+@pytest.mark.parametrize("accum", [1, 2])
+def test_long_trajectory_combined_knobs(kind, accum, monkeypatch):
+    """The full-stack long trajectory: ring reduction on every leaf +
+    fused apply + remat='dots' vs the plain baseline, 40 steps.
+
+    With all three reassociating knobs stacked, pointwise parity decays
+    over long horizons — float-noise gradient differences compound
+    through adam's normalizer (measured ~3% dense / ~5% MoE relative by
+    step 40 while both curves track the same descent). Pinned: tight
+    pointwise parity over the first 10 steps, a loose whole-trajectory
+    envelope that still catches real divergence (blowup, stall), and
+    matching net progress."""
+    monkeypatch.setattr(transformer_mod, "_RING_MIN_ELEMS", 1)
+    losses_b, _ = _trajectory(*_build(kind, accum=accum), steps=40)
+    losses_o, _ = _trajectory(
+        *_build(kind, accum=accum, overlap="ring", fused=True,
+                remat="dots"), steps=40)
+    np.testing.assert_allclose(losses_o[:10], losses_b[:10],
+                               rtol=5e-3, atol=1e-5)
+    np.testing.assert_allclose(losses_o, losses_b, rtol=0.15, atol=1e-5)
+    assert losses_o[-1] < losses_o[0] - 0.5
+    np.testing.assert_allclose(losses_o[0] - losses_o[-1],
+                               losses_b[0] - losses_b[-1], rtol=0.15)
