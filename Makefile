@@ -7,7 +7,8 @@
 TEST_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 KERAS_BACKEND=jax
 
-.PHONY: test test-fast test-chaos test-perf bench bench-serving bench-lm
+.PHONY: test test-fast test-chaos test-perf bench bench-serving bench-paged \
+	bench-lm
 
 test:
 	$(TEST_ENV) bash scripts/run_tests.sh -x -q
@@ -35,6 +36,12 @@ bench-serving:
 	r = {'serving': bench.bench_serving(3), \
 	     'serving_fastpath': bench.bench_serving_fastpath(3)}; \
 	print(json.dumps(r))"
+
+# Paged-KV bench only: concurrency at a fixed KV HBM budget (dense slots
+# vs the paged pool) plus the prefix-cache hit ratio.
+bench-paged:
+	KERAS_BACKEND=jax python -c "import json, bench; \
+	print(json.dumps({'paged_kv': bench.bench_paged_kv(3)}))"
 
 # LM section only, forced on (BENCH_LM=1 runs it even off-TPU): the judged
 # geometry with per-phase timing (fwd_ms / bwd_reduce_ms / apply_ms /

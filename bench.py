@@ -614,15 +614,168 @@ def bench_serving_fastpath(reps: int):
                 bestk, outk = rk, ok
         for got, want in zip(outk, out1):
             np.testing.assert_array_equal(got, want)  # same tokens, faster
+        # KV HBM per concurrent request, alongside the tok/s: dense
+        # reserves max_len positions per slot whether used or not; the
+        # paged pool (PR 7) holds only the pages live tokens touch. Both
+        # engines are merely CONSTRUCTED here — buffer bytes, no compile.
+        import jax as _jax
+        page = 16
+        per_req_pages = -(-(prompt_len + max_new) // page)
+        dense_bytes = sum(
+            int(np.prod(a.shape)) * a.dtype.itemsize
+            for a in _jax.tree_util.tree_leaves(
+                ServingEngine(model, params, n_slots=slots).kv.cache))
+        paged_bytes = ServingEngine(
+            model, params, n_slots=slots, paged=True, page_size=page,
+            pages_per_partition=slots * per_req_pages + 1,
+        ).kv.memory_stats()["kv_hbm_bytes"]
         out[f"slots{slots}"] = {
             "single_tok_s": round(best1, 1),
             "fused_tok_s": round(bestk, 1),
             "speedup": round(bestk / best1, 2),
+            "kv_hbm_bytes_per_request_dense": dense_bytes // slots,
+            "kv_hbm_bytes_per_request_paged": paged_bytes // slots,
         }
         log(f"serving fastpath: slots={slots} "
-            f"{out[f'slots{slots}']['speedup']:.2f}x fused speedup")
+            f"{out[f'slots{slots}']['speedup']:.2f}x fused speedup, "
+            f"KV/req dense {dense_bytes // slots:,}B "
+            f"vs paged {paged_bytes // slots:,}B")
     out["config"] = (f"d{d_model}xL{n_layers}xH{n_heads}-V{vocab}"
                      f"-p{prompt_len}n{max_new}")
+    return out
+
+
+def bench_paged_kv(reps: int):
+    """Paged-KV serving concurrency at a FIXED KV HBM budget.
+
+    CPU-runnable. Two engines serve the SAME workload (short prompts
+    sharing a system prefix, greedy) with the SAME number of KV
+    token-positions in HBM: the dense ``SlotKVCache`` spends them as
+    ``dense_slots × max_len`` reserved rows, the paged engine as a pool
+    of ``page``-token pages that only live tokens occupy. Because each
+    request touches ~``ceil((prompt+new)/page)`` pages instead of a whole
+    ``max_len`` row, the paged engine runs ``paged_slots`` (default 4x)
+    requests CONCURRENTLY inside the identical budget — the headline is
+    the peak-concurrency ratio, with decode tok/s and the prefix-cache
+    hit ratio (every request shares the system-prefix page) alongside.
+    Greedy outputs are asserted token-identical between the engines. Skip
+    with BENCH_SERVING=0; geometry via BENCH_PAGED_{DMODEL,LAYERS,VOCAB,
+    MAXLEN,PAGE,DENSE_SLOTS,PAGED_SLOTS,PROMPT,NEW}.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    if os.environ.get("BENCH_SERVING", "1") == "0":
+        log("paged kv bench: skipped (BENCH_SERVING=0)")
+        return None
+
+    from elephas_tpu.models import TransformerLM
+    from elephas_tpu.serving import ServingEngine
+
+    def knob(name, default):
+        return int(os.environ.get(f"BENCH_PAGED_{name.upper()}", default))
+
+    d_model = knob("dmodel", 64)
+    n_layers = knob("layers", 2)
+    n_heads = max(1, d_model // 64)
+    vocab = knob("vocab", 512)
+    max_len = knob("maxlen", 256)
+    page = knob("page", 16)
+    dense_slots = knob("dense_slots", 4)
+    paged_slots = knob("paged_slots", 4 * dense_slots)
+    prompt_len = knob("prompt", 24)
+    max_new = knob("new", 8)
+    n_requests = 2 * paged_slots
+
+    model = TransformerLM(
+        vocab=vocab, d_model=d_model, n_heads=n_heads, n_layers=n_layers,
+        d_ff=4 * d_model, max_len=max_len, pos_encoding="rotary",
+        tie_embeddings=True,
+    )
+    params = {k: jnp.asarray(v) for k, v in model.init(seed=0).items()}
+
+    # the paged pool gets EXACTLY the dense engine's token-positions
+    # (trash page included), so the comparison is at fixed KV HBM
+    pool_pages = dense_slots * max_len // page
+
+    rng = np.random.default_rng(0)
+    tail = max(1, prompt_len - page)        # shared prefix spans >=1 page
+    system = rng.integers(0, vocab, size=(prompt_len - tail,)).astype(np.int32)
+    prompts = [
+        np.concatenate(
+            [system, rng.integers(0, vocab, size=(tail,)).astype(np.int32)])
+        for _ in range(n_requests)
+    ]
+
+    def run(**kw):
+        """Submit everything, step to empty; returns (decode tok/s, peak
+        concurrent active slots, per-request tokens, engine)."""
+        eng = ServingEngine(model, params, max_queue=2 * n_requests, **kw)
+        ids = [eng.submit(p, max_new) for p in prompts]
+        peak, steps = 0, 0
+        t0 = time.perf_counter()
+        while eng.scheduler.queue_depth or eng.kv.active_slots:
+            eng.step()
+            peak = max(peak, eng.kv.active_slots)
+            steps += 1
+            if steps > 1_000_000:
+                raise RuntimeError("paged kv bench did not drain")
+        dt = time.perf_counter() - t0
+        fins = [eng.result(r, pop=False) for r in ids]
+        return n_requests * max_new / dt, peak, [f.tokens for f in fins], eng
+
+    log(f"paged kv: dense {dense_slots} slots vs paged {paged_slots} slots "
+        f"at {dense_slots * max_len} KV token-positions (compiling...)")
+    run(n_slots=dense_slots)                 # warmup/compile both engines
+    run(n_slots=paged_slots, paged=True, page_size=page,
+        pages_per_partition=pool_pages)
+    best_d = best_p = 0.0
+    peak_d = peak_p = 0
+    toks_d = toks_p = None
+    eng_d = eng_p = None
+    for rep in range(max(1, reps)):
+        rd, pd, od, ed = run(n_slots=dense_slots)
+        rp, pp, op, ep = run(n_slots=paged_slots, paged=True, page_size=page,
+                             pages_per_partition=pool_pages)
+        log(f"paged kv rep {rep}: dense {rd:,.0f} tok/s @ {pd} concurrent, "
+            f"paged {rp:,.0f} tok/s @ {pp} concurrent")
+        if rd > best_d:
+            best_d, peak_d, toks_d, eng_d = rd, pd, od, ed
+        if rp > best_p:
+            best_p, peak_p, toks_p, eng_p = rp, pp, op, ep
+    for got, want in zip(toks_p, toks_d):
+        np.testing.assert_array_equal(got, want)  # same tokens, more of them
+    dense_bytes = sum(
+        int(np.prod(a.shape)) * a.dtype.itemsize
+        for a in jax.tree_util.tree_leaves(eng_d.kv.cache))
+    mem = eng_p.snapshot()["memory"]
+    out = {
+        "page_size": page,
+        "kv_hbm_budget_bytes": dense_bytes,
+        "dense": {
+            "n_slots": dense_slots,
+            "kv_hbm_bytes": dense_bytes,
+            "tok_s": round(best_d, 1),
+            "peak_concurrency": peak_d,
+        },
+        "paged": {
+            "n_slots": paged_slots,
+            "kv_hbm_bytes": mem["kv_hbm_bytes"],
+            "tok_s": round(best_p, 1),
+            "peak_concurrency": peak_p,
+            "prefix_hit_ratio": mem["prefix"]["hit_ratio"],
+            "preemptions": mem["preemptions"],
+        },
+        "concurrency_ratio": round(peak_p / max(1, peak_d), 2),
+        "config": (f"d{d_model}xL{n_layers}xH{n_heads}-V{vocab}"
+                   f"-p{prompt_len}n{max_new}-T{max_len}"),
+    }
+    assert mem["kv_hbm_bytes"] <= dense_bytes, "paged pool exceeds budget"
+    log(f"paged kv: {out['concurrency_ratio']:.1f}x concurrency at fixed "
+        f"HBM, prefix hit ratio "
+        f"{out['paged']['prefix_hit_ratio']:.2f}")
     return out
 
 
@@ -1004,6 +1157,16 @@ def main():
         fastpath = None
     if fastpath is not None:
         result["serving_fastpath"] = fastpath
+        print(json.dumps(result), flush=True)
+
+    # -- paged KV phase: concurrency at fixed HBM budget (CPU-runnable) ---
+    try:
+        paged_kv = bench_paged_kv(reps)
+    except Exception as e:
+        log(f"paged kv bench failed: {type(e).__name__}: {e}")
+        paged_kv = None
+    if paged_kv is not None:
+        result["paged_kv"] = paged_kv
         print(json.dumps(result), flush=True)
 
     # -- recovery phase: checkpoint + auto-resume tax (CPU-runnable) ------

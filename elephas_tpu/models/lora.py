@@ -21,6 +21,7 @@ machinery of any kind.
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, Dict, Optional, Sequence, Tuple
 
 import jax
@@ -217,6 +218,200 @@ def load_lora(path: str, base_params: Dict[str, Any]) -> Dict[str, Any]:
                 )
             out[name] = LoRATensor(w, a, b, float(blob[f"{name}.alpha"]))
     return out
+
+
+class MultiTenantLM(TransformerLM):
+    """A :class:`TransformerLM` carrying ``n_adapters`` STACKED LoRA
+    adapters for multi-tenant serving: one base model, many fine-tuned
+    variants, selected PER BATCH ROW inside the decode kernel.
+
+    The adapter factors live in the params dict as layer-stacked
+    ``lora_w{t}_a`` ``[L, A, D, r]`` / ``lora_w{t}_b`` ``[L, A, r, out]``
+    for each target projection ``t`` (q/k/v/o). :meth:`_attn_proj` adds
+    ``(α/r)·(x@A[row])@B[row]`` when an adapter-row vector is active —
+    installed via :meth:`adapter_context` INSIDE a traced kernel body, so
+    the row ids are an ordinary traced argument of the program (never
+    captured constants; the compiled kernel serves any row→adapter
+    assignment). ``B`` initializes to zero, so adapter 0 (and every fresh
+    adapter) is exactly the base model — the serving engine's token-identity
+    guarantee for un-adapted tenants.
+
+    Tenancy is a serving concept: training a single adapter still goes
+    through :func:`apply_lora` on a plain model; :meth:`load_adapter`
+    installs the trained factors into one stack row here.
+    """
+
+    def __init__(self, *args, n_adapters: int = 4, lora_rank: int = 4,
+                 lora_alpha: Optional[float] = None,
+                 lora_targets: Sequence[str] = ("q", "v"), **kwargs):
+        super().__init__(*args, **kwargs)
+        if n_adapters < 1:
+            raise ValueError(f"n_adapters must be >= 1, got {n_adapters}")
+        if lora_rank < 1:
+            raise ValueError(f"lora_rank must be >= 1, got {lora_rank}")
+        targets = tuple(lora_targets)
+        bad = [t for t in targets if t not in ("q", "k", "v", "o")]
+        if bad or len(set(targets)) != len(targets):
+            raise ValueError(
+                f"lora_targets must be distinct members of q/k/v/o, "
+                f"got {targets}")
+        self.n_adapters = int(n_adapters)
+        self.lora_rank = int(lora_rank)
+        self.lora_alpha = float(2 * lora_rank if lora_alpha is None
+                                else lora_alpha)
+        self.lora_targets = targets
+        self._adapter_rows = None  # traced [rows] int vector, or None
+
+    # -- params ----------------------------------------------------------
+    def _lora_out_dim(self, t: str) -> int:
+        Dkv = (self.d_model // self.n_heads) * self.n_kv_heads
+        return self.d_model if t in ("q", "o") else Dkv
+
+    def param_shapes(self) -> Dict[str, jax.ShapeDtypeStruct]:
+        shapes = super().param_shapes()
+        sds = jax.ShapeDtypeStruct
+        L, A, D, r = (self.n_layers, self.n_adapters, self.d_model,
+                      self.lora_rank)
+        for t in self.lora_targets:
+            shapes[f"lora_w{t}_a"] = sds((L, A, D, r), jnp.float32)
+            shapes[f"lora_w{t}_b"] = sds((L, A, r, self._lora_out_dim(t)),
+                                         jnp.float32)
+        return shapes
+
+    def init(self, seed: int = 0) -> Dict[str, np.ndarray]:
+        out = super().init(seed)
+        # LoRA convention (apply_lora above): A ~ N(0, 1/r), B = 0 — every
+        # adapter starts EXACTLY at the base model.
+        rng = np.random.default_rng(np.random.SeedSequence([seed, 0x10A]))
+        for t in self.lora_targets:
+            a_key, b_key = f"lora_w{t}_a", f"lora_w{t}_b"
+            out[a_key] = (
+                rng.normal(size=self.param_shapes()[a_key].shape)
+                / np.sqrt(self.lora_rank)
+            ).astype(np.float32)
+            out[b_key] = np.zeros(self.param_shapes()[b_key].shape,
+                                  np.float32)
+        return out
+
+    def _block_keys(self):
+        keys = super()._block_keys()
+        extra = []
+        for t in self.lora_targets:
+            extra += [f"lora_w{t}_a", f"lora_w{t}_b"]
+        return keys + tuple(extra)
+
+    # -- the kernel-side hook -------------------------------------------
+    @contextlib.contextmanager
+    def adapter_context(self, rows):
+        """Activate per-row adapter selection: ``rows`` int ``[B]`` — the
+        adapter id of each batch row in every subsequent projection. MUST
+        be entered inside the traced kernel body (``rows`` a traced arg),
+        never around a jit boundary."""
+        prev = self._adapter_rows
+        self._adapter_rows = rows
+        try:
+            yield
+        finally:
+            self._adapter_rows = prev
+
+    def _attn_proj(self, lp, name: str, x):
+        y = super()._attn_proj(lp, name, x)
+        rows = self._adapter_rows
+        if rows is None or name not in self.lora_targets:
+            return y
+        cd = x.dtype
+        # lp slices are per-layer: [A, D, r] / [A, r, out]; gather each
+        # row's factors, two thin matmuls, scaled residual delta.
+        a = lp[f"lora_w{name}_a"].astype(cd)[rows]
+        b = lp[f"lora_w{name}_b"].astype(cd)[rows]
+        scale = self.lora_alpha / self.lora_rank
+        if x.ndim == 2:        # decode step: x [S, D]
+            delta = jnp.einsum("sd,sdr->sr", x, a)
+            delta = jnp.einsum("sr,sro->so", delta, b)
+        else:                  # prefill/chunk: x [S, T, D]
+            delta = jnp.einsum("std,sdr->str", x, a)
+            delta = jnp.einsum("str,sro->sto", delta, b)
+        return y + scale * delta.astype(cd)
+
+    # -- host helpers ----------------------------------------------------
+    def load_adapter(self, params: Dict[str, Any], adapter_id: int,
+                     factors: Dict[str, Tuple[Any, Any]]) -> Dict[str, Any]:
+        """Install trained factors into stack row ``adapter_id``:
+        ``factors`` maps target letter → ``(a [L, D, r], b [L, r, out])``.
+        Returns a new params dict (stacks are rebuilt, not mutated)."""
+        if not 0 <= adapter_id < self.n_adapters:
+            raise ValueError(f"adapter_id {adapter_id} out of range "
+                             f"[0, {self.n_adapters})")
+        out = dict(params)
+        for t, (a, b) in factors.items():
+            if t not in self.lora_targets:
+                raise ValueError(f"{t!r} is not an adapted target "
+                                 f"{self.lora_targets}")
+            for key, new in ((f"lora_w{t}_a", a), (f"lora_w{t}_b", b)):
+                stack = jnp.asarray(out[key])
+                new = jnp.asarray(new, stack.dtype)
+                if new.shape != stack.shape[:1] + stack.shape[2:]:
+                    raise ValueError(
+                        f"{key} row must be {stack.shape[:1] + stack.shape[2:]},"
+                        f" got {new.shape}")
+                out[key] = stack.at[:, adapter_id].set(new)
+        return out
+
+    def randomize_adapter(self, params: Dict[str, Any], adapter_id: int,
+                          seed: int = 0, scale: float = 0.02) -> Dict[str, Any]:
+        """Give adapter ``adapter_id`` a nonzero delta (small random ``B``)
+        — the test/bench shortcut for 'a tenant whose outputs must differ
+        from the base'."""
+        rng = np.random.default_rng(np.random.SeedSequence([seed, adapter_id]))
+        factors = {}
+        for t in self.lora_targets:
+            a = np.asarray(params[f"lora_w{t}_a"])[:, adapter_id]
+            b = (rng.normal(size=np.asarray(
+                params[f"lora_w{t}_b"]).shape[0:1] + np.asarray(
+                params[f"lora_w{t}_b"]).shape[2:]) * scale).astype(np.float32)
+            factors[t] = (a, b)
+        return self.load_adapter(params, adapter_id, factors)
+
+    def merged_params(self, params: Dict[str, Any],
+                      adapter_id: int) -> Dict[str, Any]:
+        """Bake ONE adapter into plain dense weights — the single-tenant
+        deployment form, and the equivalence oracle for tests (the merged
+        model's ``apply`` must match the batched delta path numerically)."""
+        if not 0 <= adapter_id < self.n_adapters:
+            raise ValueError(f"adapter_id {adapter_id} out of range "
+                             f"[0, {self.n_adapters})")
+        scale = self.lora_alpha / self.lora_rank
+        out = {}
+        for k, v in params.items():
+            if k.startswith("lora_"):
+                continue
+            out[k] = v
+        for t in self.lora_targets:
+            a = jnp.asarray(params[f"lora_w{t}_a"])[:, adapter_id]
+            b = jnp.asarray(params[f"lora_w{t}_b"])[:, adapter_id]
+            w = jnp.asarray(params[f"w{t}"])
+            out[f"w{t}"] = w + scale * jnp.einsum(
+                "ldr,lro->ldo", a.astype(jnp.float32), b.astype(jnp.float32))
+        return out
+
+    def base_model(self) -> TransformerLM:
+        """The architecture-equal plain :class:`TransformerLM` (for
+        ``merged_params`` consumers — its param_shapes match the merged
+        dict exactly)."""
+        m = TransformerLM(
+            self.vocab, self.d_model, self.n_heads, self.n_layers,
+            self.d_ff, self.max_len,
+            compute_dtype=str(self.compute_dtype),
+            pos_encoding=self.pos_encoding,
+            tie_embeddings=self.tie_embeddings,
+            n_kv_heads=self.n_kv_heads, activation=self.activation,
+            norm=self.norm, norm_eps=self.norm_eps,
+            attn_bias=self.attn_bias, ffn_bias=self.ffn_bias,
+            rope_theta=self.rope_theta,
+            attn_window=(self.attn_windows if self.mixed_window
+                         else self.attn_window),
+        )
+        return m
 
 
 def build_lora_lm_train_step(model: TransformerLM, mesh: Mesh, optimizer,

@@ -65,10 +65,13 @@ from ..parallel.mesh import DATA_AXIS
 from .transformer import (
     SEQ_AXIS,
     TransformerLM,
+    _adapter_ctx,
     _period_group,
     _period_ungroup,
     _rope_angles,
     _rope_rotate,
+    paged_gather_view,
+    paged_scatter_rows,
     select_slot_tokens,
     select_tokens,
 )
@@ -383,6 +386,153 @@ def build_lm_generate(model: TransformerLM, mesh: Mesh,
     return generate_fn
 
 
+def _prefill_slice_sharded(model: TransformerLM, capacity: int, Tl: int,
+                           params, tokens, aid=None):
+    """Replicated full prefill of ``tokens`` ``[1, Tb]`` into a transient
+    full-``capacity`` K/V buffer, sliced down to THIS seq rank's
+    ``[r·Tl, (r+1)·Tl)`` rows → ``(logits [1, Tb, V], new_k, new_v)`` with
+    ``new_k/new_v [L, 1, Hkv, Tl, Dh]``. The shared front half of the
+    dense insert and the paged insert: tokens are replicated, so the
+    logits come back replicated on every rank with no collective. ``aid``
+    (replicated scalar, optional) selects the adapter for multi-tenant
+    models — it must be replicated or the logits stop being."""
+    L = model.n_layers
+    Hkv = model.n_kv_heads
+    Dh = model.d_model // model.n_heads
+    cd = model.compute_dtype
+    r_seq = jax.lax.axis_index(SEQ_AXIS)
+    tmp = {
+        "k": jnp.zeros((L, 1, Hkv, capacity, Dh), cd),
+        "v": jnp.zeros((L, 1, Hkv, capacity, Dh), cd),
+    }
+    with _adapter_ctx(model,
+                      None if aid is None else jnp.reshape(aid, (1,))):
+        logits, tmp = model.prefill(params, tokens, tmp, ffn_tag="ring")
+    new_k = jax.lax.dynamic_slice_in_dim(tmp["k"], r_seq * Tl, Tl, axis=3)
+    new_v = jax.lax.dynamic_slice_in_dim(tmp["v"], r_seq * Tl, Tl, axis=3)
+    return logits, new_k, new_v
+
+
+def _chunk_row_sharded(model: TransformerLM, Tl: int, params, row, tokens,
+                       t_last, pos0, own):
+    """Chunk-continuation forward of ``tokens`` ``[1, C]`` at absolute
+    positions ``pos0..`` against ONE slot row's local time slice ``row``
+    ``{"k"/"v": [L, 1, Hkv, Tl, Dh]}``: scatter the chunk's K/V into the
+    slice (out-of-slice and non-owner writes drop), matrix-matrix scores
+    against it under the global causal/window mask, logsumexp-merge the
+    partials over ``"seq"``, and replicate the owner's ``t_last`` logits
+    by a masked ``psum`` over ``"data"``. The shared middle of the dense
+    chunk insert and the paged chunk insert; ``own`` is this data rank's
+    ownership predicate (non-owners run on a surrogate row whose writes
+    all drop, so their returned row is bitwise the input). Returns
+    ``(last [V], {"k"/"v": new row})``."""
+    C = tokens.shape[1]
+    H = model.n_heads
+    Hkv = model.n_kv_heads
+    Dh = model.d_model // H
+    cd = model.compute_dtype
+    r_seq = jax.lax.axis_index(SEQ_AXIS)
+
+    pos_b = pos0 + jnp.arange(C)[None, :]           # [1, C] absolute
+    h = model._embed(params, tokens, pos_b)         # [1, C, D]
+    rope = model._rope_for(pos_b)
+    # chunk→slice write coordinates: unique, consecutive; anything
+    # out of this rank's slice — or on a non-owner data rank — is
+    # redirected to Tl, which scatter mode="drop" discards (NEVER a
+    # negative index: numpy-style wrap would corrupt the slice tail)
+    local_t = pos_b[0] - r_seq * Tl                 # [C]
+    write_t = jnp.where((local_t >= 0) & (local_t < Tl) & own,
+                        local_t, Tl)
+    slots_g = r_seq * Tl + jnp.arange(Tl)           # [Tl] global pos
+
+    def mask_for(window):
+        # [1, C, Tl]: query i (global pos0+i) sees global slots
+        # <= its position, window-clamped below for this layer
+        m = slots_g[None, None, :] <= pos_b[:, :, None]
+        if window is not None:
+            m &= slots_g[None, None, :] > pos_b[:, :, None] - window
+        return m
+
+    def one_layer(h, lp, kc, vc, window):
+        # kc/vc [1, Hkv, Tl, Dh] — this rank's slice of the slot row
+        x = model._norm_h(lp, "ln1", h).astype(cd)
+        q = model._attn_proj(lp, "q", x).reshape(1, C, H, Dh)
+        k_new = model._attn_proj(lp, "k", x).reshape(1, C, Hkv, Dh)
+        v_new = model._attn_proj(lp, "v", x).reshape(1, C, Hkv, Dh)
+        if rope is not None:
+            q = _rope_rotate(q, *rope)
+            k_new = _rope_rotate(k_new, *rope)
+        kc = kc.at[:, :, write_t, :].set(
+            k_new.transpose(0, 2, 1, 3), mode="drop")
+        vc = vc.at[:, :, write_t, :].set(
+            v_new.transpose(0, 2, 1, 3), mode="drop")
+        # matrix-matrix scores against the local slice, then the
+        # logsumexp merge over "seq" (same identity as the decode
+        # step's flash-decode merge; exp(-inf)=0 drops masked slots,
+        # and the global max is finite — every query at least sees
+        # its own just-written position on its owner rank)
+        qg = q.transpose(0, 2, 1, 3).reshape(1, Hkv, H // Hkv, C, Dh)
+        scores = jnp.einsum(
+            "bkgsd,bktd->bkgst", qg, kc,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        ) * (Dh ** -0.5)
+        scores = jnp.where(mask_for(window)[:, None, None], scores,
+                           -jnp.inf)
+        m_r = jnp.max(scores, axis=-1)              # [1, Hkv, G, C]
+        m = jax.lax.pmax(m_r, SEQ_AXIS)
+        w = jnp.exp(scores - m[..., None])
+        s_r = jnp.sum(w, axis=-1)
+        o_r = jnp.einsum(
+            "bkgst,bktd->bkgsd", w, vc,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        den = jax.lax.psum(s_r, SEQ_AXIS)
+        num = jax.lax.psum(o_r, SEQ_AXIS)
+        a = (num / den[..., None]).astype(cd)       # [1, Hkv, G, C, Dh]
+        a = a.reshape(1, H, C, Dh).transpose(0, 2, 1, 3)
+        h = h + model._attn_proj(lp, "o", a.reshape(1, C, model.d_model))
+        x = model._norm_h(lp, "ln2", h).astype(cd)
+        out, _ = model._ffn(lp, x, "ring", SEQ_AXIS, ep_groups=1)
+        return h + out.astype(cd), kc, vc
+
+    pp = model._window_period()
+
+    def block(h, inputs):
+        lp, kc, vc = inputs
+        if pp == 1:
+            h, kc, vc = one_layer(h, lp, kc, vc, model.attn_windows[0])
+            return h, (kc, vc)
+        kcs, vcs = [], []
+        for g in range(pp):
+            h, kc_g, vc_g = one_layer(
+                h, {k: v[g] for k, v in lp.items()}, kc[g], vc[g],
+                model.attn_windows[g])
+            kcs.append(kc_g)
+            vcs.append(vc_g)
+        return h, (jnp.stack(kcs), jnp.stack(vcs))
+
+    lps = {k: params[k] for k in model._block_keys()}
+    ck, cv = row["k"], row["v"]
+    if pp > 1:
+        lps = _period_group(lps, pp)
+        ck = _period_group(ck, pp)
+        cv = _period_group(cv, pp)
+    h, (kc_new, vc_new) = jax.lax.scan(block, h, (lps, ck, cv))
+    if pp > 1:
+        kc_new = _period_ungroup(kc_new, model.n_layers)
+        vc_new = _period_ungroup(vc_new, model.n_layers)
+    h = model._norm_h(params, "lnf", h)
+    logits = model._logits(params, h)               # [1, C, V]
+    last = jax.lax.dynamic_index_in_dim(logits[0], t_last, axis=0,
+                                        keepdims=False)
+    # replicate the OWNER's logits (non-owner data ranks computed on
+    # surrogate rows — garbage h, masked out of the sum)
+    last = jax.lax.psum(jnp.where(own, last, 0.0), DATA_AXIS)
+    return last, {"k": kc_new, "v": vc_new}
+
+
 class ServingOps(NamedTuple):
     """The sharded programs the serving engine drives (plus the cache
     factory matching their layout). Signatures are identical to the
@@ -477,17 +627,9 @@ def build_serving_ops(model: TransformerLM, mesh: Mesh, n_slots: int,
     def _insert_impl(params, cache, tokens, t_last, slot):
         # local cache [L, S_local, Hkv, Tl, Dh]; tokens [1, Tb] replicated
         S_local = cache["k"].shape[1]
-        r_seq = jax.lax.axis_index(SEQ_AXIS)
         r_data = jax.lax.axis_index(DATA_AXIS)
-        tmp = {
-            "k": jnp.zeros((L, 1, Hkv, capacity, Dh), cd),
-            "v": jnp.zeros((L, 1, Hkv, capacity, Dh), cd),
-        }
-        logits, tmp = model.prefill(params, tokens, tmp, ffn_tag="ring")
-        new_k = jax.lax.dynamic_slice_in_dim(tmp["k"], r_seq * Tl, Tl,
-                                             axis=3)
-        new_v = jax.lax.dynamic_slice_in_dim(tmp["v"], r_seq * Tl, Tl,
-                                             axis=3)
+        logits, new_k, new_v = _prefill_slice_sharded(
+            model, capacity, Tl, params, tokens)
         slot_local = slot - r_data * S_local
         own = (slot_local >= 0) & (slot_local < S_local)
         idx = jnp.clip(slot_local, 0, S_local - 1)
@@ -504,125 +646,25 @@ def build_serving_ops(model: TransformerLM, mesh: Mesh, n_slots: int,
         # Chunk-train continuation: ``tokens`` [1, C] at absolute
         # positions pos0.. against slot ``slot``'s EXISTING sharded row.
         # Local cache [L, S_local, Hkv, Tl, Dh]; everything but the cache
-        # is replicated. See build_serving_ops' docstring for the shape
-        # of the computation.
+        # is replicated. The forward itself lives in _chunk_row_sharded
+        # (shared with the paged path); this wrapper only gathers and
+        # re-scatters the slot row.
         S_local = cache["k"].shape[1]
-        C = tokens.shape[1]
-        H = model.n_heads
-        Hkv = model.n_kv_heads
-        Dh = model.d_model // H
-        cd = model.compute_dtype
-        r_seq = jax.lax.axis_index(SEQ_AXIS)
         r_data = jax.lax.axis_index(DATA_AXIS)
         slot_local = slot - r_data * S_local
         own = (slot_local >= 0) & (slot_local < S_local)
         idx = jnp.clip(slot_local, 0, S_local - 1)
         # non-owner data ranks gather a surrogate row they write back
-        # unchanged (their chunk writes all drop below)
+        # unchanged (their chunk writes all drop inside)
         row = {n: jax.lax.dynamic_slice_in_dim(cache[n], idx, 1, axis=1)
                for n in ("k", "v")}        # [L, 1, Hkv, Tl, Dh]
-
-        pos_b = pos0 + jnp.arange(C)[None, :]           # [1, C] absolute
-        h = model._embed(params, tokens, pos_b)         # [1, C, D]
-        rope = model._rope_for(pos_b)
-        # chunk→slice write coordinates: unique, consecutive; anything
-        # out of this rank's slice — or on a non-owner data rank — is
-        # redirected to Tl, which scatter mode="drop" discards (NEVER a
-        # negative index: numpy-style wrap would corrupt the slice tail)
-        local_t = pos_b[0] - r_seq * Tl                 # [C]
-        write_t = jnp.where((local_t >= 0) & (local_t < Tl) & own,
-                            local_t, Tl)
-        slots_g = r_seq * Tl + jnp.arange(Tl)           # [Tl] global pos
-
-        def mask_for(window):
-            # [1, C, Tl]: query i (global pos0+i) sees global slots
-            # <= its position, window-clamped below for this layer
-            m = slots_g[None, None, :] <= pos_b[:, :, None]
-            if window is not None:
-                m &= slots_g[None, None, :] > pos_b[:, :, None] - window
-            return m
-
-        def one_layer(h, lp, kc, vc, window):
-            # kc/vc [1, Hkv, Tl, Dh] — this rank's slice of the slot row
-            x = model._norm_h(lp, "ln1", h).astype(cd)
-            q = model._attn_proj(lp, "q", x).reshape(1, C, H, Dh)
-            k_new = model._attn_proj(lp, "k", x).reshape(1, C, Hkv, Dh)
-            v_new = model._attn_proj(lp, "v", x).reshape(1, C, Hkv, Dh)
-            if rope is not None:
-                q = _rope_rotate(q, *rope)
-                k_new = _rope_rotate(k_new, *rope)
-            kc = kc.at[:, :, write_t, :].set(
-                k_new.transpose(0, 2, 1, 3), mode="drop")
-            vc = vc.at[:, :, write_t, :].set(
-                v_new.transpose(0, 2, 1, 3), mode="drop")
-            # matrix-matrix scores against the local slice, then the
-            # logsumexp merge over "seq" (same identity as the decode
-            # step's flash-decode merge; exp(-inf)=0 drops masked slots,
-            # and the global max is finite — every query at least sees
-            # its own just-written position on its owner rank)
-            qg = q.transpose(0, 2, 1, 3).reshape(1, Hkv, H // Hkv, C, Dh)
-            scores = jnp.einsum(
-                "bkgsd,bktd->bkgst", qg, kc,
-                preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST,
-            ) * (Dh ** -0.5)
-            scores = jnp.where(mask_for(window)[:, None, None], scores,
-                               -jnp.inf)
-            m_r = jnp.max(scores, axis=-1)              # [1, Hkv, G, C]
-            m = jax.lax.pmax(m_r, SEQ_AXIS)
-            w = jnp.exp(scores - m[..., None])
-            s_r = jnp.sum(w, axis=-1)
-            o_r = jnp.einsum(
-                "bkgst,bktd->bkgsd", w, vc,
-                preferred_element_type=jnp.float32,
-                precision=jax.lax.Precision.HIGHEST,
-            )
-            den = jax.lax.psum(s_r, SEQ_AXIS)
-            num = jax.lax.psum(o_r, SEQ_AXIS)
-            a = (num / den[..., None]).astype(cd)       # [1, Hkv, G, C, Dh]
-            a = a.reshape(1, H, C, Dh).transpose(0, 2, 1, 3)
-            h = h + model._attn_proj(lp, "o", a.reshape(1, C, model.d_model))
-            x = model._norm_h(lp, "ln2", h).astype(cd)
-            out, _ = model._ffn(lp, x, "ring", SEQ_AXIS, ep_groups=1)
-            return h + out.astype(cd), kc, vc
-
-        pp = model._window_period()
-
-        def block(h, inputs):
-            lp, kc, vc = inputs
-            if pp == 1:
-                h, kc, vc = one_layer(h, lp, kc, vc, model.attn_windows[0])
-                return h, (kc, vc)
-            kcs, vcs = [], []
-            for g in range(pp):
-                h, kc_g, vc_g = one_layer(
-                    h, {k: v[g] for k, v in lp.items()}, kc[g], vc[g],
-                    model.attn_windows[g])
-                kcs.append(kc_g)
-                vcs.append(vc_g)
-            return h, (jnp.stack(kcs), jnp.stack(vcs))
-
-        lps = {k: params[k] for k in model._block_keys()}
-        ck, cv = row["k"], row["v"]
-        if pp > 1:
-            lps = _period_group(lps, pp)
-            ck = _period_group(ck, pp)
-            cv = _period_group(cv, pp)
-        h, (kc_new, vc_new) = jax.lax.scan(block, h, (lps, ck, cv))
-        if pp > 1:
-            kc_new = _period_ungroup(kc_new, model.n_layers)
-            vc_new = _period_ungroup(vc_new, model.n_layers)
-        h = model._norm_h(params, "lnf", h)
-        logits = model._logits(params, h)               # [1, C, V]
-        last = jax.lax.dynamic_index_in_dim(logits[0], t_last, axis=0,
-                                            keepdims=False)
-        # replicate the OWNER's logits (non-owner data ranks computed on
-        # surrogate rows — garbage h, masked out of the sum)
-        last = jax.lax.psum(jnp.where(own, last, 0.0), DATA_AXIS)
-        out = {}
-        for n, new in (("k", kc_new), ("v", vc_new)):
-            out[n] = jax.lax.dynamic_update_slice_in_dim(
-                cache[n], new, idx, axis=1)
+        last, new_row = _chunk_row_sharded(model, Tl, params, row, tokens,
+                                           t_last, pos0, own)
+        out = {
+            n: jax.lax.dynamic_update_slice_in_dim(cache[n], new_row[n],
+                                                   idx, axis=1)
+            for n in ("k", "v")
+        }
         return last, out
 
     def _decode_impl(params, cache, tokens, pos, temps, keys, live):
@@ -726,3 +768,305 @@ def build_serving_ops(model: TransformerLM, mesh: Mesh, n_slots: int,
     return ServingOps(init_cache=init_cache, insert=insert, decode=decode,
                       decode_fused=decode_fused, max_len=max_len,
                       capacity=capacity)
+
+
+class PagedServingOps(NamedTuple):
+    """The PAGED serving programs (see ``serving/memory.py``): same loop
+    contract as :class:`ServingOps`, but the KV lives in a refcounted page
+    pool read through per-slot block tables, and every program carries the
+    device table (plus per-slot adapter ids on the decode paths). The
+    pool is donated through every program; the table/aids are small,
+    host-cached, and never donated."""
+
+    init_pool: Any     # () -> {"k"/"v": [L, dp·sp·Pl, Hkv, page, Dh]} placed
+    upload_table: Any  # np [S, M] -> placed device table
+    upload_aids: Any   # np [S] -> placed device adapter ids
+    insert: Any        # (params, pool, table, tokens[1,Tb], t_last, slot, pos0, aid) -> (last[V], pool)
+    decode: Any        # (params, pool, table, aids, tok, pos, temps, keys, live) -> (emit, tok, pos, pool)
+    decode_fused: Any  # (..., live, n_steps=K) -> (emit[S,K], tok, pos, pool)
+    max_len: int
+    capacity: int      # logical per-slot horizon = sp · Tl
+    Tl: int            # per-partition time slice
+    page: int
+    Ml: int            # logical pages per partition slice = Tl // page
+    pages_per_partition: int
+    dp: int
+    sp: int
+
+
+def build_paged_serving_ops(model: TransformerLM, mesh: Mesh, n_slots: int,
+                            max_len: Optional[int] = None,
+                            page_size: int = 16,
+                            pages_per_partition: Optional[int] = None
+                            ) -> PagedServingOps:
+    """Compile the paged serving programs over ``mesh``: slots shard over
+    ``"data"`` and each slot's LOGICAL time axis over ``"seq"`` exactly as
+    in :func:`build_serving_ops` — but physical KV rows live in a page
+    pool of ``pages_per_partition`` pages per ``(data, seq)`` partition
+    (pool row ``p·Pl + i`` is page ``i`` of partition ``p = d·sp + q``;
+    page 0 of each partition is the trash page). Block tables hold LOCAL
+    page ids; cell ``(s, m)`` of the global ``[S, M]`` table belongs to
+    partition ``(s // Sl)·sp + (m // Ml)``.
+
+    Every program gathers the dense per-slot view through the table
+    (:func:`paged_gather_view` — the view's time axis equals ``Tl``, so
+    the attention math and its reduction trees are EXACTLY the dense
+    programs': insert = prefill-then-slice, chunk = ``_chunk_row_sharded``,
+    decode = ``_decode_step_sharded``), then scatters only the written
+    rows/pages back, redirecting non-owner and unmapped writes to the
+    trash page. ``page_size`` must divide ``Tl`` — that equality of time
+    axes IS the bit-identity contract with the dense engine. Adapter ids
+    ride along: the insert paths take one replicated scalar (logits must
+    stay replicated), the decode paths a ``"data"``-sharded ``[S]``
+    vector, both applied via the model's ``adapter_context`` when it has
+    one (:class:`MultiTenantLM`)."""
+    _check_mesh_and_specs(model, mesh)
+    if model._ring_cache:
+        raise NotImplementedError(
+            "serving needs a linear (horizon) cache; all-windowed models "
+            "allocate rolling buffers (see TransformerLM.prefill_slot)"
+        )
+    sp = mesh.shape[SEQ_AXIS]
+    dp = mesh.shape[DATA_AXIS]
+    if n_slots % dp:
+        raise ValueError(
+            f"n_slots={n_slots} not divisible by data axis size {dp}")
+    max_len = int(model.max_len if max_len is None else max_len)
+    Tl = _local_cache_len(max_len, sp)
+    capacity = sp * Tl
+    page = int(page_size)
+    if page < 1 or Tl % page:
+        raise ValueError(
+            f"page_size {page} must divide the per-shard cache length {Tl} "
+            f"(the dense-view bit-identity contract)")
+    Ml = Tl // page
+    Sl = n_slots // dp
+    if pages_per_partition is None:
+        pages_per_partition = Sl * Ml + 1
+    Pl = int(pages_per_partition)
+    if Pl < 2:
+        raise ValueError(f"pages_per_partition must be >= 2, got {Pl}")
+    L = model.n_layers
+    Hkv = model.n_kv_heads
+    Dh = model.d_model // model.n_heads
+    cd = model.compute_dtype
+    pool_spec = P(None, (DATA_AXIS, SEQ_AXIS), None, None, None)
+    pool_specs = {"k": pool_spec, "v": pool_spec}
+    table_spec = P(DATA_AXIS, SEQ_AXIS)
+    aids_spec = P(DATA_AXIS)
+    pspecs = model.specs()
+
+    def init_pool():
+        sh = NamedSharding(mesh, pool_spec)
+        shape = (L, dp * sp * Pl, Hkv, page, Dh)
+        # two DISTINCT buffers: XLA refuses donation of aliased inputs
+        return {"k": jax.device_put(jnp.zeros(shape, cd), sh),
+                "v": jax.device_put(jnp.zeros(shape, cd), sh)}
+
+    def upload_table(table_np):
+        return jax.device_put(jnp.asarray(table_np, jnp.int32),
+                              NamedSharding(mesh, table_spec))
+
+    def upload_aids(aids_np):
+        return jax.device_put(jnp.asarray(aids_np, jnp.int32),
+                              NamedSharding(mesh, aids_spec))
+
+    def _scatter_local_row(pool, trow, own, new_k, new_v):
+        # write one slot's local [Tl] slice back as Ml whole pages:
+        # new_k/new_v [L, 1, Hkv, Tl, Dh]; trow [1, Ml] local page ids.
+        # Non-owner data ranks redirect every id to the trash page; so do
+        # unmapped table cells (already 0). Duplicate trash coordinates
+        # are undefined-pick — trash is never read unmasked.
+        ids = jnp.where(own, trow[0], 0)
+        out = {}
+        for n, new in (("k", new_k), ("v", new_v)):
+            vals = new[:, 0].reshape(L, Hkv, Ml, page, Dh)
+            vals = vals.transpose(0, 2, 1, 3, 4)   # [L, Ml, Hkv, page, Dh]
+            out[n] = pool[n].at[:, ids].set(vals, mode="drop")
+        return out
+
+    def _paged_insert_impl(params, pool, table, tokens, t_last, slot, aid):
+        # local: pool [L, Pl, Hkv, page, Dh], table [Sl, Ml]
+        Sl_, Ml_ = table.shape
+        r_data = jax.lax.axis_index(DATA_AXIS)
+        logits, new_k, new_v = _prefill_slice_sharded(
+            model, capacity, Tl, params, tokens, aid=aid)
+        slot_local = slot - r_data * Sl_
+        own = (slot_local >= 0) & (slot_local < Sl_)
+        idx = jnp.clip(slot_local, 0, Sl_ - 1)
+        trow = jax.lax.dynamic_slice(table, (idx, 0), (1, Ml_))
+        pool = _scatter_local_row(pool, trow, own, new_k, new_v)
+        last = jax.lax.dynamic_index_in_dim(logits[0], t_last, axis=0,
+                                            keepdims=False)
+        return last, pool
+
+    def _paged_chunk_impl(params, pool, table, tokens, t_last, slot, pos0,
+                          aid):
+        Sl_, Ml_ = table.shape
+        r_data = jax.lax.axis_index(DATA_AXIS)
+        slot_local = slot - r_data * Sl_
+        own = (slot_local >= 0) & (slot_local < Sl_)
+        idx = jnp.clip(slot_local, 0, Sl_ - 1)
+        trow = jax.lax.dynamic_slice(table, (idx, 0), (1, Ml_))
+        # surrogate rows on non-owner ranks, same as the dense chunk
+        row = {n: paged_gather_view(pool[n], trow, page)
+               for n in ("k", "v")}       # [L, 1, Hkv, Tl, Dh]
+        with _adapter_ctx(model, jnp.reshape(aid, (1,))):
+            last, new_row = _chunk_row_sharded(model, Tl, params, row,
+                                               tokens, t_last, pos0, own)
+        pool = _scatter_local_row(pool, trow, own, new_row["k"],
+                                  new_row["v"])
+        return last, pool
+
+    def _paged_decode_impl(params, pool, table, aids, tokens, pos, temps,
+                           keys, live):
+        # local: tokens/pos/temps/live/aids [Sl], keys [Sl, 2]
+        view = {n: paged_gather_view(pool[n], table, page)
+                for n in ("k", "v")}      # [L, Sl, Hkv, Tl, Dh]
+        with _adapter_ctx(model, aids):
+            logits, kc, vc = _decode_step_sharded(
+                model, params, tokens, pos, view["k"], view["v"], Tl)
+        emit = select_slot_tokens(logits, pos + 1, temps, keys)
+        r_seq = jax.lax.axis_index(SEQ_AXIS)
+        pos_local = pos - r_seq * Tl
+        own_seq = (pos_local >= 0) & (pos_local < Tl)
+        idx = jnp.clip(pos_local, 0, Tl - 1)
+        pids = jnp.where(
+            own_seq,
+            jnp.take_along_axis(table, (idx // page)[:, None],
+                                axis=1)[:, 0], 0)
+        offs = idx % page
+        new_pool = {}
+        for n, v in (("k", kc), ("v", vc)):
+            rows = jnp.take_along_axis(
+                v, idx[None, :, None, None, None], axis=3)[:, :, :, 0]
+            new_pool[n] = paged_scatter_rows(pool[n], rows, pids, offs)
+        tokens = jnp.where(live, emit, tokens)
+        pos = jnp.where(live, pos + 1, pos)
+        return emit, tokens, pos, new_pool
+
+    def _paged_fused_impl(n_steps, params, pool, table, aids, tokens, pos,
+                          temps, keys, live):
+        view = {n: paged_gather_view(pool[n], table, page)
+                for n in ("k", "v")}
+
+        def body(carry, _):
+            tok, p, kc, vc = carry
+            with _adapter_ctx(model, aids):
+                logits, kc, vc = _decode_step_sharded(
+                    model, params, tok, p, kc, vc, Tl)
+            emit = select_slot_tokens(logits, p + 1, temps, keys)
+            tok = jnp.where(live, emit, tok)
+            p = jnp.where(live, p + 1, p)
+            return (tok, p, kc, vc), emit
+
+        (tokens_out, pos_out, kc, vc), emitted = jax.lax.scan(
+            body, (tokens, pos, view["k"], view["v"]), None,
+            length=n_steps)
+
+        # flattened write-back of all S × K rows using the ORIGINAL pos
+        # (non-live rows repeat their write head — duplicate coordinates
+        # carry identical final-view values)
+        r_seq = jax.lax.axis_index(SEQ_AXIS)
+        S_ = pos.shape[0]
+        steps = jnp.arange(n_steps)
+        posj = jnp.where(live[:, None], pos[:, None] + steps[None, :],
+                         pos[:, None])                 # [Sl, K]
+        pos_local = posj - r_seq * Tl
+        own_seq = (pos_local >= 0) & (pos_local < Tl)
+        idx = jnp.clip(pos_local, 0, Tl - 1)
+        pids = jnp.where(own_seq,
+                         jnp.take_along_axis(table, idx // page, axis=1), 0)
+        offs = idx % page
+        new_pool = {}
+        for n, v in (("k", kc), ("v", vc)):
+            rows = jnp.take_along_axis(
+                v, idx[None, :, None, :, None], axis=3)  # [L,Sl,Hkv,K,Dh]
+            rows = rows.transpose(0, 1, 3, 2, 4).reshape(
+                L, S_ * n_steps, rows.shape[2], rows.shape[4])
+            new_pool[n] = paged_scatter_rows(pool[n], rows,
+                                             pids.reshape(S_ * n_steps),
+                                             offs.reshape(S_ * n_steps))
+        return emitted.T, tokens_out, pos_out, new_pool
+
+    insert_programs: Dict[int, Any] = {}
+    chunk_programs: Dict[int, Any] = {}
+
+    def insert(params, pool, table, tokens, t_last, slot, pos0, aid):
+        Tb = int(tokens.shape[1])
+        if int(pos0) == 0:
+            if Tb not in insert_programs:
+                insert_programs[Tb] = jax.jit(
+                    shard_map(
+                        _paged_insert_impl,
+                        mesh=mesh,
+                        in_specs=(pspecs, pool_specs, table_spec,
+                                  P(None, None), P(), P(), P()),
+                        out_specs=(P(), pool_specs),
+                        check_vma=False,
+                    ),
+                    donate_argnums=(1,),
+                )
+            return insert_programs[Tb](
+                params, pool, table, jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(t_last, jnp.int32),
+                jnp.asarray(slot, jnp.int32), jnp.asarray(aid, jnp.int32))
+        if Tb not in chunk_programs:
+            chunk_programs[Tb] = jax.jit(
+                shard_map(
+                    _paged_chunk_impl,
+                    mesh=mesh,
+                    in_specs=(pspecs, pool_specs, table_spec,
+                              P(None, None), P(), P(), P(), P()),
+                    out_specs=(P(), pool_specs),
+                    check_vma=False,
+                ),
+                donate_argnums=(1,),
+            )
+        return chunk_programs[Tb](
+            params, pool, table, jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(t_last, jnp.int32), jnp.asarray(slot, jnp.int32),
+            jnp.asarray(pos0, jnp.int32), jnp.asarray(aid, jnp.int32))
+
+    state_specs = (P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                   P(DATA_AXIS, None), P(DATA_AXIS))
+    decode = jax.jit(
+        shard_map(
+            _paged_decode_impl,
+            mesh=mesh,
+            in_specs=(pspecs, pool_specs, table_spec, aids_spec)
+            + state_specs,
+            out_specs=(P(DATA_AXIS), P(DATA_AXIS), P(DATA_AXIS),
+                       pool_specs),
+            check_vma=False,
+        ),
+        donate_argnums=(1,),
+    )
+
+    fused_programs: Dict[int, Any] = {}
+
+    def decode_fused(params, pool, table, aids, tokens, pos, temps, keys,
+                     live, n_steps: int):
+        K = int(n_steps)
+        if K not in fused_programs:
+            fused_programs[K] = jax.jit(
+                shard_map(
+                    functools.partial(_paged_fused_impl, K),
+                    mesh=mesh,
+                    in_specs=(pspecs, pool_specs, table_spec, aids_spec)
+                    + state_specs,
+                    out_specs=(P(DATA_AXIS, None), P(DATA_AXIS),
+                               P(DATA_AXIS), pool_specs),
+                    check_vma=False,
+                ),
+                donate_argnums=(1,),
+            )
+        return fused_programs[K](params, pool, table, aids, tokens, pos,
+                                 temps, keys, live)
+
+    return PagedServingOps(init_pool=init_pool, upload_table=upload_table,
+                           upload_aids=upload_aids, insert=insert,
+                           decode=decode, decode_fused=decode_fused,
+                           max_len=max_len, capacity=capacity, Tl=Tl,
+                           page=page, Ml=Ml,
+                           pages_per_partition=Pl, dp=dp, sp=sp)

@@ -30,6 +30,7 @@ Design notes (TPU-first):
 
 from __future__ import annotations
 
+import contextlib
 from functools import partial
 from typing import Any, Dict, Optional
 
@@ -532,6 +533,50 @@ def cache_scatter_slot(cache, slot, slot_cache):
                                                axis=1)
         for n, c in cache.items()
     }
+
+
+def paged_gather_view(pool, table, page: int):
+    """Materialize the dense per-slot view of a PAGED KV pool: ``pool``
+    ``[L, P, Hkv, page, Dh]`` (P physical pages; page 0 is the trash page)
+    read through ``table`` ``[S, M]`` int32 (per-slot block tables of
+    physical page ids) → ``[L, S, Hkv, M·page, Dh]``.
+
+    This is THE paged attention read: one gather puts every slot's logical
+    time axis back in dense layout, so the existing decode/chunk kernels
+    run unchanged on the view and stay bit-identical to the dense
+    ``SlotKVCache`` path — the view's time axis equals the dense capacity,
+    so the attention reductions group identically. Unallocated logical
+    pages read the trash page; everything there is at masked (``> pos``)
+    positions, whose contributions are exactly zero (finite garbage times
+    an exp(−inf) weight), so the view is safe by the same staleness-repair
+    invariant the dense cache relies on. XLA fuses the gather into the
+    attention consumer; a TPU Pallas kernel reading through the table
+    in-VMEM is the drop-in upgrade (see ops/flash_decode.py)."""
+    g = pool[:, table]                    # [L, S, M, Hkv, page, Dh]
+    L, S, M, Hkv, pg, Dh = g.shape
+    return g.transpose(0, 1, 3, 2, 4, 5).reshape(L, S, Hkv, M * pg, Dh)
+
+
+def paged_scatter_rows(pool, rows, pids, offs):
+    """Scatter one written time-row per slot back into the paged pool:
+    ``rows`` ``[L, S, Hkv, Dh]`` (the position each slot's decode step just
+    wrote, extracted from the dense view) lands at ``pool[:, pids[s], :,
+    offs[s]]``. Dead/non-owner slots pass ``pids == 0`` — the trash page
+    absorbs their garbage writes (duplicate trash coordinates may race;
+    trash is never read unmasked, so any winner is fine)."""
+    vals = rows.transpose(1, 0, 2, 3)     # [S, L, Hkv, Dh]
+    return pool.at[:, pids, :, offs].set(vals, mode="drop")
+
+
+def _adapter_ctx(model, rows):
+    """Enter ``model``'s per-slot adapter context when it has one
+    (:class:`~elephas_tpu.models.lora.MultiTenantLM` — ``rows`` selects
+    each batch row's adapter inside every ``_attn_proj`` traced under the
+    context); plain models get a no-op, so one kernel source serves both."""
+    ctx = getattr(model, "adapter_context", None)
+    if ctx is None:
+        return contextlib.nullcontext()
+    return ctx(rows)
 
 
 def _cache_update_rows(cache, new, pos, per_row: bool):

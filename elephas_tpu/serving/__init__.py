@@ -16,6 +16,19 @@ The split mirrors the repo's driver-orchestrates/compiled-workers shape:
   ``[L, slots, Hkv, T, Dh]`` KV buffer whose batch axis is the SLOT axis;
   a request prefill-inserts into a free slot (``prefill_slot`` →
   ``decode_chunk``), decodes in place, and releases the slot on finish.
+  This dense layout reserves ``max_len`` positions per slot whether used
+  or not — the simple baseline the paged subsystem replaces.
+- :mod:`~elephas_tpu.serving.memory` — ``PagedKVCache``: the paged
+  alternative (``paged=True`` on the engine). KV lives in a pool of
+  fixed-size PAGES; per-slot block tables map logical positions to
+  refcounted pages, so HBM scales with LIVE TOKENS, not
+  ``slots × max_len``. A radix-tree prefix cache shares pages between
+  requests with a common token prefix (copy-on-write: forks incref,
+  divergence allocates a fresh tail page), skipping their prefill; a
+  stacked multi-tenant LoRA path
+  (:class:`~elephas_tpu.models.lora.MultiTenantLM`) selects a per-slot
+  adapter inside the same batched decode program. Token-identical to the
+  dense engine, greedy and sampled, local and mesh.
 - :mod:`~elephas_tpu.serving.scheduler` — bounded FIFO+priority admission
   queue (reject-with-reason backpressure) and the per-iteration
   prefill-vs-decode decision.
@@ -37,12 +50,18 @@ THROUGHPUT, never drift.
 
 from .cache import SlotKVCache
 from .engine import FinishedRequest, ServingEngine
+from .memory import (BlockAllocator, PagedKVCache, PagesExhausted,
+                     RadixPrefixCache)
 from .metrics import ServingMetrics
 from .scheduler import AdmissionError, Scheduler, ServingRequest
 
 __all__ = [
     "AdmissionError",
+    "BlockAllocator",
     "FinishedRequest",
+    "PagedKVCache",
+    "PagesExhausted",
+    "RadixPrefixCache",
     "Scheduler",
     "ServingEngine",
     "ServingMetrics",

@@ -71,6 +71,7 @@ injectable clock for them would perturb fake-clock tests.
 
 from __future__ import annotations
 
+import itertools
 import time
 from dataclasses import dataclass
 from functools import partial
@@ -82,6 +83,7 @@ import numpy as np
 
 from ..models.transformer import select_slot_tokens
 from .cache import SlotKVCache, bucket_length
+from .memory import PagedKVCache, PagesExhausted
 from .metrics import RequestTiming, ServingMetrics
 from .scheduler import AdmissionError, Scheduler, ServingRequest
 
@@ -166,7 +168,9 @@ class ServingEngine:
                  mesh=None, clock: Callable[[], float] = time.monotonic,
                  metrics_window: int = 1024, max_finished: int = 1024,
                  fault_plan=None, prefill_chunk: Optional[int] = None,
-                 fuse_k: int = 1):
+                 fuse_k: int = 1, paged: bool = False, page_size: int = 16,
+                 pages_per_partition: Optional[int] = None,
+                 prefix_cache: bool = True):
         if max_finished < 1:
             raise ValueError(f"max_finished must be >= 1, got {max_finished}")
         if fuse_k < 1:
@@ -192,7 +196,29 @@ class ServingEngine:
         self._step_index = 0
         self.scheduler = Scheduler(max_queue=max_queue)
         self.metrics = ServingMetrics(n_slots=n_slots, window=metrics_window)
-        if mesh is None:
+        self._paged = bool(paged)
+        if paged:
+            # paged engine: the KV pool + block tables live in PagedKVCache,
+            # which exposes the same insert/decode surface the driver loop
+            # already speaks (local and mesh) — the loop below is unchanged
+            self.kv = PagedKVCache(
+                model, params, n_slots, max_len=max_len,
+                page_size=page_size,
+                pages_per_partition=pages_per_partition,
+                prefix_cache=prefix_cache, mesh=mesh)
+            self._insert_fn = None          # PagedKVCache dispatches inside
+            self._decode_fn = self.kv.decode_fn
+            self._fused_fn = self.kv.fused_fn
+            if mesh is None:
+                state_shardings = [None] * 5
+            else:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                from ..parallel.mesh import DATA_AXIS
+                row = NamedSharding(mesh, P(DATA_AXIS))
+                state_shardings = [row, row, row,
+                                   NamedSharding(mesh, P(DATA_AXIS, None)),
+                                   row]
+        elif mesh is None:
             self.kv = SlotKVCache(model, params, n_slots, max_len=max_len)
             self._insert_fn = None          # SlotKVCache's compiled default
             self._decode_fn = partial(_decode_kernel, model)
@@ -232,6 +258,7 @@ class ServingEngine:
         self._requests: Dict[str, ServingRequest] = {}
         self._finished: Dict[str, FinishedRequest] = {}
         self._next_id = 0
+        self._admit_seq = itertools.count()  # preemption recency order
 
     # -- time ------------------------------------------------------------
     def _now(self) -> float:
@@ -245,14 +272,18 @@ class ServingEngine:
                eos_id: Optional[int] = None, priority: int = 0,
                seed: int = 0, on_token: Optional[Callable] = None,
                request_id: Optional[str] = None,
-               deadline_s: Optional[float] = None) -> str:
+               deadline_s: Optional[float] = None,
+               adapter_id: int = 0) -> str:
         """Enqueue one generation request; returns its id. Raises
         :class:`AdmissionError` (with a machine-readable ``.reason``) on
         validation failure or queue backpressure — rejected work never
         holds a queue entry or a slot. ``deadline_s`` bounds the request's
         whole lifetime from submit: once exceeded it is reaped at the next
         ``step()`` with ``finish_reason="deadline"`` and whatever tokens it
-        produced, and its slot is reclaimed."""
+        produced, and its slot is reclaimed. ``adapter_id`` selects the
+        request's LoRA variant on a paged engine serving a
+        :class:`~elephas_tpu.models.lora.MultiTenantLM` (0 = the base
+        model everywhere)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         T0 = prompt.shape[0]
         rid = request_id or f"req-{self._next_id}"
@@ -276,11 +307,29 @@ class ServingEngine:
                     "length_exceeds_cache",
                     f"prompt {T0} + max_new {max_new} exceeds "
                     f"max_len {self.kv.max_len}")
+            n_adapters = int(getattr(self.model, "n_adapters", 1))
+            if adapter_id != 0 and not self._paged:
+                raise AdmissionError(
+                    "bad_request",
+                    f"adapter_id {adapter_id}: non-zero adapters need the "
+                    f"paged engine (paged=True)")
+            if not 0 <= adapter_id < max(n_adapters, 1):
+                raise AdmissionError(
+                    "bad_request",
+                    f"adapter_id {adapter_id} not in [0, {n_adapters})")
+            if self._paged and not self.kv.fits(T0 + int(max_new)):
+                raise AdmissionError(
+                    "length_exceeds_cache",
+                    f"prompt {T0} + max_new {max_new} cannot fit the page "
+                    f"pool even alone "
+                    f"({self.kv.pages_per_partition - 1} usable pages per "
+                    f"partition of {self.kv.page} tokens)")
             submitted_at = self._now()
             req = ServingRequest(
                 request_id=rid, prompt=prompt, max_new=int(max_new),
                 temperature=float(temperature), eos_id=eos_id,
                 priority=int(priority), seed=int(seed), on_token=on_token,
+                adapter_id=int(adapter_id),
                 deadline_at=(None if deadline_s is None
                              else submitted_at + float(deadline_s)),
                 timing=RequestTiming(request_id=rid, prompt_tokens=int(T0),
@@ -310,10 +359,12 @@ class ServingEngine:
         # live decode rows only: a partially-prefilled slot is allocated
         # but must not count as decodable (with no live rows its chunks
         # run back-to-back instead of alternating with no-op decodes)
+        free_pages, need_pages = self._admission_budget()
         action = self.scheduler.decide(
             self.kv.free_slots, len(self._slot_req),
             has_partial=self._partial is not None,
-            last_action=self._last_action)
+            last_action=self._last_action,
+            free_pages=free_pages, need_pages=need_pages)
         if action == "prefill":
             req = self.scheduler.pop()
             if req is not None:
@@ -324,6 +375,26 @@ class ServingEngine:
             self._do_decode()
         self._last_action = action
         return action
+
+    def _admission_budget(self):
+        """``(free_pages, need_pages)`` for the queue HEAD on the paged
+        engine — what :meth:`Scheduler.decide` gates admission on —
+        ``(None, None)`` whenever pages are not the binding constraint
+        (dense engine, empty queue, no free slot, open chunk train).
+        ``need`` counts only pages BEYOND the head's cached prefix, and
+        the check may evict clean prefix pages to make room, so a cache
+        hit admits under pressure a cold prompt would wait out."""
+        if (not self._paged or self._partial is not None
+                or not self.scheduler.queue_depth
+                or self.kv.free_slots == 0):
+            return None, None
+        head = self.scheduler.peek()
+        if head is None:
+            return None, None
+        # rank of the slot allocate() would hand out next
+        rank = self.kv._free[-1] // self.kv.Sl
+        return self.kv.admission_check(
+            self._req_prompt(head), head.adapter_id, rank)
 
     # -- early termination ------------------------------------------------
     def cancel(self, request_id: str) -> bool:
@@ -402,10 +473,13 @@ class ServingEngine:
             self.metrics.observe_result_evicted()
 
     def snapshot(self) -> Dict[str, object]:
-        """Engine + request metrics as one JSON-able dict."""
+        """Engine + request metrics as one JSON-able dict; on the paged
+        engine a ``"memory"`` section reports page utilization, KV HBM
+        bytes, preemptions, and the prefix-cache hit ratio."""
         return self.metrics.snapshot(
             active_slots=self.kv.active_slots,
-            queue_depth=self.scheduler.queue_depth)
+            queue_depth=self.scheduler.queue_depth,
+            memory=self.kv.memory_stats() if self._paged else None)
 
     # -- device step state -------------------------------------------------
     def _set_row(self, slot: int, tok: int, pos: int, temp: float,
@@ -421,31 +495,48 @@ class ServingEngine:
         self._set_row(slot, 0, 0, 0.0, np.zeros(2, np.uint32), False)
 
     # -- internals -------------------------------------------------------
+    @staticmethod
+    def _req_prompt(req: ServingRequest) -> np.ndarray:
+        """The tokens this admission must prefill: the original prompt,
+        or — after a preemption — prompt ++ already-generated (the resumed
+        request re-ingests its own continuation so the token stream picks
+        up exactly where it stopped; selection is ``(seed, position)``-
+        keyed, so the resumed stream is identical)."""
+        return req.prompt if req.resume_prompt is None else req.resume_prompt
+
     def _do_prefill(self, req: ServingRequest) -> None:
         slot = self.kv.allocate()
         req.timing.admitted_at = self._now()
         req.slot = slot
         self.metrics.observe_prefill()
+        prompt = self._req_prompt(req)
+        T0 = int(prompt.shape[0])
+        if self._paged:
+            self.kv.set_adapter(slot, req.adapter_id)
+            req.admit_seq = next(self._admit_seq)
+            # prefix-cache hit: adopted pages skip their prefill outright
+            req.prefill_pos = self.kv.adopt_prefix(slot, prompt)
         C = self.prefill_chunk
-        if C is not None and int(req.prompt.shape[0]) > C:
+        if C is not None and T0 - req.prefill_pos > C:
             # long prompt: open a chunk train — first chunk now, the rest
             # interleaved with decode by the scheduler
             self._partial = req
             self._do_prefill_chunk()
             return
-        last = self.kv.insert(slot, req.prompt, insert_fn=self._insert_fn)
+        last = self._insert_guarded(req, prompt[req.prefill_pos:],
+                                    pos0=req.prefill_pos)
         self._start_decoding(req, last)
 
     def _do_prefill_chunk(self) -> None:
         """Advance the open chunk train by one chunk; the FINAL chunk's
         last real logits select the first token and the slot goes live."""
         req = self._partial
-        T0 = int(req.prompt.shape[0])
+        prompt = self._req_prompt(req)
+        T0 = int(prompt.shape[0])
         start = req.prefill_pos
         end = min(start + self.prefill_chunk, T0)
         t0 = time.perf_counter()
-        last = self.kv.insert(req.slot, req.prompt[start:end],
-                              insert_fn=self._insert_fn, pos0=start)
+        last = self._insert_guarded(req, prompt[start:end], pos0=start)
         last.block_until_ready()
         self.metrics.observe_prefill_chunk(
             end - start, len(self._slot_req), time.perf_counter() - t0)
@@ -464,15 +555,95 @@ class ServingEngine:
         """Shared admission tail: select the first token from the prompt's
         last real logits, stamp timing, and make the slot a live decode
         row."""
-        T0 = int(req.prompt.shape[0])
+        T0 = int(self._req_prompt(req).shape[0])
         key = np.asarray(jax.random.PRNGKey(req.seed), np.uint32)
         tok = int(_select_first(last, T0, req.temperature,
                                 jnp.asarray(key)))
         req.next_pos = T0           # position `tok` occupies
-        req.timing.first_token_at = self._now()
+        if req.timing.first_token_at is None:   # preserve TTFT on resume
+            req.timing.first_token_at = self._now()
+        if self._paged:
+            # publish the now-complete prompt pages for future prefix hits
+            self.kv.register_prefix(req.slot, self._req_prompt(req))
         self._slot_req[req.slot] = req
         self._set_row(req.slot, tok, T0, req.temperature, key, True)
         self._emit(req, tok)
+
+    # -- page pressure (paged engine only) --------------------------------
+    def _insert_guarded(self, req: ServingRequest, chunk, pos0: int):
+        """``kv.insert`` with page-pressure recovery: on
+        :class:`PagesExhausted`, evict clean prefix pages — failing that,
+        preempt the newest same-rank request — and retry. A request alone
+        always fits (``kv.fits`` is checked at submit), so the loop
+        terminates."""
+        while True:
+            try:
+                return self.kv.insert(req.slot, chunk,
+                                      insert_fn=self._insert_fn, pos0=pos0)
+            except PagesExhausted as e:
+                self._relieve_pressure(e, exclude=req)
+
+    def _ensure_decode_guarded(self, n_steps: int) -> None:
+        """Pre-allocate the pages the next decode block will write, with
+        the same evict-then-preempt recovery as inserts."""
+        while True:
+            try:
+                self.kv.ensure_decode(list(self._slot_req), n_steps)
+                return
+            except PagesExhausted as e:
+                self._relieve_pressure(e)
+
+    def _relieve_pressure(self, exc: PagesExhausted,
+                          exclude: Optional[ServingRequest] = None) -> None:
+        """Free pages in the exhausted partition: clean (cache-only)
+        prefix pages first, else preempt the newest request on that
+        partition's data rank. Raises ``exc`` when neither is possible —
+        unreachable while the submit-time ``fits`` invariant holds."""
+        if self.kv.evict_pages(exc.partition, exc.shortfall) >= exc.shortfall:
+            return
+        victim = self._preempt_victim(exc.partition, exclude)
+        if victim is None:
+            raise exc
+        self._preempt(victim)
+
+    def _preempt_victim(self, partition: int,
+                        exclude: Optional[ServingRequest] = None
+                        ) -> Optional[ServingRequest]:
+        """Newest-admitted live request whose slot draws pages from
+        ``partition``'s data rank (LIFO preemption: the oldest admitted
+        work is the last to lose its slot)."""
+        rank = partition // self.kv.sp
+        cands = [r for r in self._slot_req.values()
+                 if r is not exclude and r.slot // self.kv.Sl == rank]
+        if (self._partial is not None and self._partial is not exclude
+                and self._partial.slot // self.kv.Sl == rank):
+            cands.append(self._partial)
+        return max(cands, key=lambda r: r.admit_seq) if cands else None
+
+    def _preempt(self, victim: ServingRequest) -> None:
+        """Evict a live request under page pressure: return every page it
+        holds, park its row, and requeue it at the FRONT of its priority
+        class. On re-admission it prefills prompt ++ generated-so-far and
+        continues its exact token stream (``(seed, position)``-keyed
+        selection) — preemption is invisible in the output."""
+        slot = victim.slot
+        if victim is self._partial:
+            self._partial = None
+        self._slot_req.pop(slot, None)
+        self.kv.release(slot)
+        self._park(slot)
+        # always original prompt ++ ALL generated (NOT _req_prompt: a
+        # second preemption must not re-append tokens already folded in)
+        victim.resume_prompt = np.concatenate(
+            [np.asarray(victim.prompt, np.int32),
+             np.asarray(victim.generated, np.int32)])
+        victim.slot = None
+        victim.carry = None
+        victim.prefill_pos = 0
+        victim.next_pos = 0
+        victim.preemptions += 1
+        self.kv.preemptions += 1
+        self.scheduler.requeue(victim)
 
     def _fuse_window(self) -> int:
         """How many decode steps the next decode program may fuse (1 =
@@ -498,8 +669,15 @@ class ServingEngine:
                                  for r in active)))
 
     def _do_decode(self) -> None:
-        n_active = len(self._slot_req)
         K = self._fuse_window()
+        if self._paged:
+            # decode writes land in allocated pages only: grow each active
+            # slot's tail before launching (may evict/preempt under
+            # pressure — recompute the batch if rows were preempted away)
+            self._ensure_decode_guarded(K)
+            if not self._slot_req:
+                return
+        n_active = len(self._slot_req)
         t0 = time.perf_counter()
         if K == 1:
             emit, self._tok, self._pos, self.kv.cache = self._decode_fn(

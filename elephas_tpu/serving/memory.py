@@ -1,0 +1,728 @@
+"""Paged serving memory: block allocator, radix prefix cache, paged KV.
+
+The dense :class:`~elephas_tpu.serving.cache.SlotKVCache` pins
+``slots × capacity`` KV rows in HBM whether or not anyone is using them;
+concurrency is capped by the worst case. This module replaces that with a
+vLLM-style paged layout:
+
+* **Physical pool** ``{"k"/"v": [L, P, Hkv, page, Dh]}`` — ``P`` fixed-size
+  pages per partition (local: one partition; mesh: ``dp·sp`` partitions,
+  pool rows sharded over both axes). Page 0 of every partition is the
+  **trash page**: its refcount is pinned to 1, unallocated block-table
+  cells point at it, and dead/parked rows' garbage writes land there.
+* **Block tables** ``[S, M]`` int32 — per-slot maps from logical page
+  index to LOCAL physical page id. Attention reads through the table via
+  :func:`~elephas_tpu.models.transformer.paged_gather_view`, which
+  materializes a dense per-slot view whose TIME AXIS EQUALS THE DENSE
+  CAPACITY — so the existing decode/chunk kernels run unchanged on the
+  view and their attention reductions group identically to the dense
+  path. That is the bit-identity contract, and it is why ``page`` must
+  divide the per-shard cache length.
+* **Refcounts + radix prefix cache** — full prompt pages are registered
+  in a radix tree keyed on their token content at page granularity.
+  A later request with the same prefix *adopts* the cached pages (pure
+  incref — it skips prefill for them) and shares them copy-on-write:
+  fork = incref, divergence lands in a fresh tail page. Sharing is sound
+  bitwise because every local attention path reduces over the full
+  capacity axis with masked positions contributing exactly zero, making
+  a page's K/V content a pure function of the token prefix regardless of
+  how prefill was chunked.
+* **Multi-tenant adapters** — a per-slot adapter-id vector rides along
+  with the table; models exposing ``adapter_context`` (see
+  :class:`~elephas_tpu.models.lora.MultiTenantLM`) apply their per-slot
+  low-rank deltas inside the very same compiled decode/insert kernels.
+
+Host bookkeeping (refcounts, tables, radix tree) is pure Python; device
+mutation goes through the three compiled kernels below (or the sharded
+programs from ``build_paged_serving_ops``), all of which DONATE the pool.
+"""
+
+from __future__ import annotations
+
+import itertools
+from functools import partial
+from typing import Any, Dict, FrozenSet, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.transformer import (_adapter_ctx, paged_gather_view,
+                                  paged_scatter_rows, select_slot_tokens)
+from ..ops.flash_decode import aligned_cache_length
+from .cache import bucket_length
+
+
+class PagesExhausted(RuntimeError):
+    """A partition's free list ran dry mid-allocation. The engine reacts
+    by evicting clean prefix pages and, failing that, preempting the
+    newest request; ``partition``/``shortfall`` say where and how much."""
+
+    def __init__(self, partition: int, shortfall: int):
+        super().__init__(
+            f"partition {partition} out of KV pages (short {shortfall})")
+        self.partition = int(partition)
+        self.shortfall = int(shortfall)
+
+
+class BlockAllocator:
+    """Refcounted fixed-size page allocator, one free list per partition.
+
+    Page id 0 of every partition is the trash page: refcount pinned to 1,
+    never allocated, never freed. All other pages cycle alloc → incref*
+    → decref* → free. :meth:`check` asserts the full invariant set and is
+    cheap enough to run after every operation in the fuzz tests.
+    """
+
+    def __init__(self, n_partitions: int, pages_per_partition: int):
+        if n_partitions < 1 or pages_per_partition < 2:
+            raise ValueError(
+                f"need >=1 partition and >=2 pages/partition (trash + 1), "
+                f"got {n_partitions} x {pages_per_partition}")
+        self.n_partitions = int(n_partitions)
+        self.pages_per_partition = int(pages_per_partition)
+        P = self.pages_per_partition
+        self._refs: List[List[int]] = [[0] * P
+                                       for _ in range(self.n_partitions)]
+        self._free: List[List[int]] = [list(range(P - 1, 0, -1))
+                                       for _ in range(self.n_partitions)]
+        for part in range(self.n_partitions):
+            self._refs[part][0] = 1     # trash page, pinned
+
+    def alloc(self, partition: int) -> int:
+        """Pop a free page (refcount 1) or raise :class:`PagesExhausted`."""
+        free = self._free[partition]
+        if not free:
+            raise PagesExhausted(partition, 1)
+        lid = free.pop()
+        self._refs[partition][lid] = 1
+        return lid
+
+    def incref(self, partition: int, lid: int) -> None:
+        if lid == 0 or self._refs[partition][lid] < 1:
+            raise ValueError(
+                f"incref of unallocated page {lid} in partition {partition}")
+        self._refs[partition][lid] += 1
+
+    def decref(self, partition: int, lid: int) -> None:
+        if lid == 0 or self._refs[partition][lid] < 1:
+            raise ValueError(
+                f"decref of unallocated page {lid} in partition {partition}")
+        self._refs[partition][lid] -= 1
+        if self._refs[partition][lid] == 0:
+            self._free[partition].append(lid)
+
+    def free_count(self, partition: int) -> int:
+        return len(self._free[partition])
+
+    def refcount(self, partition: int, lid: int) -> int:
+        return self._refs[partition][lid]
+
+    def check(self) -> None:
+        """Assert every allocator invariant (fuzz-test hook)."""
+        for part in range(self.n_partitions):
+            refs, free = self._refs[part], self._free[part]
+            assert refs[0] == 1, f"trash refcount {refs[0]} != 1 (p{part})"
+            assert all(r >= 0 for r in refs), f"negative refcount (p{part})"
+            assert len(set(free)) == len(free), f"free-list dup (p{part})"
+            assert 0 not in free, f"trash page on free list (p{part})"
+            for lid in free:
+                assert refs[lid] == 0, \
+                    f"free page {lid} has refcount {refs[lid]} (p{part})"
+            on_free = set(free)
+            for lid in range(1, self.pages_per_partition):
+                if refs[lid] == 0:
+                    assert lid in on_free, \
+                        f"leaked page {lid} (ref 0, not free) (p{part})"
+
+
+class _PrefixNode:
+    """One cached prefix page. ``key`` is the page's token tuple;
+    ``parent`` is the children-dict that CONTAINS this node (unlink is
+    ``del parent[key]``); the node holds ONE allocator reference on
+    ``(partition, lid)`` for as long as it exists."""
+
+    __slots__ = ("key", "parent", "children", "partition", "lid", "stamp",
+                 "depth")
+
+    def __init__(self, key, parent, partition, lid, stamp, depth):
+        self.key = key
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_PrefixNode"] = {}
+        self.partition = partition
+        self.lid = lid
+        self.stamp = stamp
+        self.depth = depth
+
+
+class RadixPrefixCache:
+    """Radix tree over token prefixes at page granularity.
+
+    One tree root per ``(data_rank, adapter_id)``: pages are physically
+    resident on one data rank's partitions, and adapters change the K/V
+    content (LoRA touches k/v projections), so sharing across either
+    would be wrong. Within a rank, a node at depth ``d`` always lives in
+    seq partition ``rank·sp + d // Ml`` — slot-independent, which is what
+    lets any slot of that rank adopt it.
+    """
+
+    def __init__(self, page: int):
+        self.page = int(page)
+        self._roots: Dict[Tuple[int, int],
+                          Dict[Tuple[int, ...], _PrefixNode]] = {}
+        self._clock = itertools.count()
+        self.n_nodes = 0
+
+    def _keys(self, tokens, n_pages: int):
+        toks = [int(t) for t in np.asarray(tokens).reshape(-1)]
+        return [tuple(toks[m * self.page:(m + 1) * self.page])
+                for m in range(n_pages)]
+
+    def match(self, rank: int, aid: int, tokens, max_pages: int,
+              touch: bool = True) -> List[_PrefixNode]:
+        """Longest cached page-chain for ``tokens`` (at most ``max_pages``
+        pages deep). ``touch`` bumps the LRU stamp of every matched node."""
+        chain: List[_PrefixNode] = []
+        children = self._roots.get((rank, aid))
+        if children is None or max_pages <= 0:
+            return chain
+        for key in self._keys(tokens, max_pages):
+            node = children.get(key)
+            if node is None:
+                break
+            if touch:
+                node.stamp = next(self._clock)
+            chain.append(node)
+            children = node.children
+        return chain
+
+    def register(self, rank: int, aid: int, tokens,
+                 pages: List[Tuple[int, int]],
+                 allocator: BlockAllocator) -> int:
+        """Walk/extend the tree along ``tokens``'s first ``len(pages)``
+        full pages. Missing nodes are created holding ``pages[m]`` (the
+        cache increfs — it owns its reference independently of any slot);
+        existing nodes keep THEIR page untouched (the registering slot
+        simply holds a duplicate copy). Returns the number of new nodes."""
+        children = self._roots.setdefault((rank, aid), {})
+        created = 0
+        for m, key in enumerate(self._keys(tokens, len(pages))):
+            node = children.get(key)
+            if node is None:
+                part, lid = pages[m]
+                allocator.incref(part, lid)
+                node = _PrefixNode(key, children, part, lid,
+                                   next(self._clock), m)
+                children[key] = node
+                created += 1
+                self.n_nodes += 1
+            else:
+                node.stamp = next(self._clock)
+            children = node.children
+        return created
+
+    def nodes(self) -> Iterator[_PrefixNode]:
+        stack = [n for root in self._roots.values() for n in root.values()]
+        while stack:
+            node = stack.pop()
+            yield node
+            stack.extend(node.children.values())
+
+    def evict(self, allocator: BlockAllocator, partition: int, n: int,
+              protect: FrozenSet[_PrefixNode] = frozenset()) -> int:
+        """Free up to ``n`` pages in ``partition`` by dropping LRU LEAF
+        nodes whose page is held by the cache alone (refcount 1) and that
+        are not in ``protect``. Returns how many pages were freed. O(tree)
+        per freed page — the tree is small relative to a decode step."""
+        freed = 0
+        while freed < n:
+            victim = None
+            for node in self.nodes():
+                if (node.partition == partition and not node.children
+                        and node not in protect
+                        and allocator.refcount(node.partition, node.lid) == 1):
+                    if victim is None or node.stamp < victim.stamp:
+                        victim = node
+            if victim is None:
+                break
+            allocator.decref(victim.partition, victim.lid)
+            del victim.parent[victim.key]
+            self.n_nodes -= 1
+            freed += 1
+        return freed
+
+
+@partial(jax.jit, static_argnames=("model", "page"), donate_argnums=(3,))
+def _paged_insert_kernel(model, page, params, pool, table, slot, tokens,
+                         t_last, pos0, aid):
+    """Paged prefill-insert: gather slot ``slot``'s dense view through its
+    block-table row, run the ordinary ``decode_chunk`` on it (adapter
+    deltas applied when the model is multi-tenant), and scatter the WHOLE
+    row of pages back. Rewriting already-shared prefix pages is a bitwise
+    no-op (the view carried their bytes through unchanged); duplicate
+    trash ids in the row make the trash write undefined-pick, which is
+    fine because trash is never read unmasked. Keyed on (model, page, Tb);
+    the pool is donated."""
+    M = table.shape[1]
+    trow = jax.lax.dynamic_slice(table, (slot, 0), (1, M))     # [1, M]
+    view = {n: paged_gather_view(pool[n], trow, page) for n in ("k", "v")}
+    with _adapter_ctx(model, jnp.reshape(aid, (1,))):
+        logits, view = model.decode_chunk(params, tokens, pos0, view)
+    last = jax.lax.dynamic_index_in_dim(logits[0], t_last, axis=0,
+                                        keepdims=False)
+    L, _, Hkv, _, Dh = pool["k"].shape
+    new_pool = {}
+    for n in ("k", "v"):
+        vals = view[n][:, 0].reshape(L, Hkv, M, page, Dh)
+        vals = vals.transpose(0, 2, 1, 3, 4)                   # [L,M,Hkv,pg,Dh]
+        new_pool[n] = pool[n].at[:, trow[0]].set(vals, mode="drop")
+    return last, new_pool
+
+
+@partial(jax.jit, static_argnames=("model", "page"), donate_argnums=(3,))
+def _paged_decode_kernel(model, page, params, pool, table, aids, tokens,
+                         pos, temps, keys, live):
+    """One batched decode step over the paged pool: gather every slot's
+    dense view, run the ordinary batched ``decode_step`` + per-slot
+    selection, then scatter back ONLY the one time-row each slot wrote.
+    Slots whose table cell at the write position is unmapped (freed rows,
+    chunk-parked rows at a page boundary) scatter into the trash page;
+    parked rows mid-page overwrite their own write-head garbage exactly
+    like the dense path, repaired by the next chunk before it is read."""
+    view = {n: paged_gather_view(pool[n], table, page) for n in ("k", "v")}
+    with _adapter_ctx(model, aids):
+        logits, view = model.decode_step(params, tokens, pos, view)
+    emit = select_slot_tokens(logits, pos + 1, temps, keys)
+    pids = jnp.take_along_axis(table, (pos // page)[:, None], axis=1)[:, 0]
+    offs = pos % page
+    new_pool = {}
+    for n in ("k", "v"):
+        rows = jnp.take_along_axis(
+            view[n], pos[None, :, None, None, None], axis=3)[:, :, :, 0]
+        new_pool[n] = paged_scatter_rows(pool[n], rows, pids, offs)
+    tokens = jnp.where(live, emit, tokens)
+    pos = jnp.where(live, pos + 1, pos)
+    return emit, tokens, pos, new_pool
+
+
+@partial(jax.jit, static_argnames=("model", "page", "n_steps"),
+         donate_argnums=(4,))
+def _paged_fused_kernel(model, page, n_steps, params, pool, table, aids,
+                        tokens, pos, temps, keys, live):
+    """``n_steps`` paged decode steps in ONE program: gather the dense
+    views once, scan the single-step body over them (writes accumulate in
+    the carried VIEWS), then scatter all ``S × n_steps`` written rows back
+    in one flattened scatter. Positions use the ORIGINAL pre-scan ``pos``
+    (non-live rows repeat their write head: duplicate coordinates carry
+    identical final-view values, so any winner is correct). Token-identical
+    to ``n_steps`` single-step launches."""
+    view = {n: paged_gather_view(pool[n], table, page) for n in ("k", "v")}
+
+    def body(carry, _):
+        tok, p, vk, vv = carry
+        with _adapter_ctx(model, aids):
+            logits, v = model.decode_step(params, tok, p, {"k": vk, "v": vv})
+        emit = select_slot_tokens(logits, p + 1, temps, keys)
+        tok = jnp.where(live, emit, tok)
+        p = jnp.where(live, p + 1, p)
+        return (tok, p, v["k"], v["v"]), emit
+
+    (tokens_out, pos_out, vk, vv), emitted = jax.lax.scan(
+        body, (tokens, pos, view["k"], view["v"]), None, length=n_steps)
+
+    cap = view["k"].shape[3]
+    steps = jnp.arange(n_steps)
+    posj = jnp.where(live[:, None], pos[:, None] + steps[None, :],
+                     pos[:, None])                             # [S, K]
+    idx = jnp.clip(posj, 0, cap - 1)
+    pids = jnp.take_along_axis(table, idx // page, axis=1)     # [S, K]
+    offs = idx % page
+    S, K = idx.shape
+    new_pool = {}
+    for n, v in (("k", vk), ("v", vv)):
+        rows = jnp.take_along_axis(
+            v, idx[None, :, None, :, None], axis=3)            # [L,S,Hkv,K,Dh]
+        rows = rows.transpose(0, 1, 3, 2, 4).reshape(
+            rows.shape[0], S * K, rows.shape[2], rows.shape[4])
+        new_pool[n] = paged_scatter_rows(pool[n], rows,
+                                         pids.reshape(S * K),
+                                         offs.reshape(S * K))
+    return emitted.T, tokens_out, pos_out, new_pool
+
+
+class PagedKVCache:
+    """Drop-in replacement for :class:`SlotKVCache` backed by the paged
+    pool: same ``allocate/insert/advance/release/pos/remaining/cache``
+    surface the engine drives, plus page bookkeeping (``_ensure_span`` /
+    ``ensure_decode``), prefix adoption/registration, eviction, admission
+    accounting, and engine-signature ``decode_fn``/``fused_fn`` wrappers
+    that fetch the device table/adapter-id arrays themselves (host copies
+    are cached behind dirty flags — decode steps re-upload nothing).
+
+    ``pages_per_partition`` defaults to the dense-equivalent pool
+    (``n_slots_local × pages_per_slot + trash``), where paged-vs-dense
+    identity holds with zero preemptions; shrink it to trade HBM for
+    occasional preemption under pressure.
+    """
+
+    def __init__(self, model, params, n_slots: int,
+                 max_len: Optional[int] = None, page_size: int = 16,
+                 pages_per_partition: Optional[int] = None,
+                 prefix_cache: bool = True, mesh=None):
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        if model._ring_cache:
+            raise NotImplementedError(
+                "PagedKVCache needs a linear (horizon) cache; all-windowed "
+                "models allocate rolling buffers (see "
+                "TransformerLM.prefill_slot)")
+        self.model = model
+        self.params = params
+        self.n_slots = int(n_slots)
+        self.max_len = int(model.max_len if max_len is None else max_len)
+        self.page = int(page_size)
+        self._ops = None
+        if mesh is None:
+            self.dp = self.sp = 1
+            self.capacity = aligned_cache_length(self.max_len)
+            self.Tl = self.capacity
+        else:
+            from ..models.sharded_generate import build_paged_serving_ops
+            self._ops = build_paged_serving_ops(
+                model, mesh, n_slots, max_len=self.max_len,
+                page_size=self.page,
+                pages_per_partition=pages_per_partition)
+            self.dp, self.sp = self._ops.dp, self._ops.sp
+            self.capacity = self._ops.capacity
+            self.Tl = self._ops.Tl
+            pages_per_partition = self._ops.pages_per_partition
+        if self.Tl % self.page:
+            raise ValueError(
+                f"page_size {self.page} must divide the per-shard cache "
+                f"length {self.Tl} (the dense-view bit-identity contract)")
+        self.Ml = self.Tl // self.page          # logical pages per shard
+        self.M = self.capacity // self.page     # logical pages per slot
+        self.Sl = self.n_slots // self.dp       # slots per data rank
+        self.n_partitions = self.dp * self.sp
+        if pages_per_partition is None:
+            pages_per_partition = self.Sl * self.Ml + 1
+        self.pages_per_partition = int(pages_per_partition)
+        self.allocator = BlockAllocator(self.n_partitions,
+                                        self.pages_per_partition)
+        self.prefix: Optional[RadixPrefixCache] = (
+            RadixPrefixCache(self.page) if prefix_cache else None)
+
+        if self._ops is not None:
+            self.cache = self._ops.init_pool()
+        else:
+            L = model.n_layers
+            Hkv = model.n_kv_heads
+            Dh = model.d_model // model.n_heads
+            shape = (L, self.pages_per_partition, Hkv, self.page, Dh)
+            # DISTINCT buffers: XLA refuses donation of aliased inputs
+            self.cache = {"k": jnp.zeros(shape, model.compute_dtype),
+                          "v": jnp.zeros(shape, model.compute_dtype)}
+
+        S, M = self.n_slots, self.M
+        self.table = np.zeros((S, M), np.int32)
+        self.aids = np.zeros(S, np.int32)
+        self.owned: List[Dict[int, Tuple[int, int]]] = [{} for _ in range(S)]
+        self.pos = np.zeros(S, np.int32)
+        self._free: List[int] = list(range(S - 1, -1, -1))
+        self._table_dev = None
+        self._aids_dev = None
+        self._table_dirty = True
+        self._aids_dirty = True
+        self.preemptions = 0
+        self._prefix_hits = 0
+        self._prefix_lookups = 0
+
+    # -- slot accounting (SlotKVCache surface) ---------------------------
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def active_slots(self) -> int:
+        return self.n_slots - len(self._free)
+
+    def allocate(self) -> int:
+        if not self._free:
+            raise RuntimeError("no free slot (caller must check free_slots)")
+        return self._free.pop()
+
+    def release(self, slot: int) -> None:
+        if slot in self._free or not 0 <= slot < self.n_slots:
+            raise ValueError(f"bad release of slot {slot}")
+        for part, lid in self.owned[slot].values():
+            self.allocator.decref(part, lid)
+        self.owned[slot] = {}
+        self.table[slot, :] = 0
+        self.aids[slot] = 0
+        self.pos[slot] = 0
+        self._table_dirty = True
+        self._aids_dirty = True
+        self._free.append(slot)
+
+    def advance(self, slot: int) -> None:
+        self.pos[slot] += 1
+
+    def remaining(self, slot: int) -> int:
+        return self.max_len - int(self.pos[slot])
+
+    # -- page bookkeeping ------------------------------------------------
+    def _partition(self, slot: int, m: int) -> int:
+        """Physical partition holding slot ``slot``'s logical page ``m``:
+        data rank ``slot // Sl``, seq shard ``m // Ml``."""
+        return (slot // self.Sl) * self.sp + (m // self.Ml)
+
+    def set_adapter(self, slot: int, adapter_id: int) -> None:
+        self.aids[slot] = int(adapter_id)
+        self._aids_dirty = True
+
+    def _ensure_span(self, slot: int, lo: int, hi: int) -> None:
+        """Allocate (idempotently) every page covering positions
+        ``[lo, hi)`` of ``slot``. Raises :class:`PagesExhausted` mid-way
+        on shortage — already-allocated pages stay owned, so the caller
+        can evict/preempt and simply retry."""
+        if hi <= lo:
+            return
+        for m in range(lo // self.page, (hi - 1) // self.page + 1):
+            if m not in self.owned[slot]:
+                part = self._partition(slot, m)
+                lid = self.allocator.alloc(part)
+                self.owned[slot][m] = (part, lid)
+                self.table[slot, m] = lid
+                self._table_dirty = True
+
+    def ensure_decode(self, slots, n_steps: int) -> None:
+        """Allocate the pages the next ``n_steps`` decode writes of each
+        active slot will land in (positions ``pos .. pos+n_steps-1``)."""
+        for slot in slots:
+            p = int(self.pos[slot])
+            self._ensure_span(slot, p, p + n_steps)
+
+    # -- prefix cache ----------------------------------------------------
+    def adopt_prefix(self, slot: int, prompt) -> int:
+        """Adopt the longest cached page-chain matching ``prompt`` for
+        ``slot`` (pure increfs — cannot fail) and return how many PROMPT
+        TOKENS are covered. Capped at ``(T0-1)//page`` pages so at least
+        one real token remains to prefill (the first-token logits must
+        come from a genuine forward)."""
+        if self.prefix is None:
+            return 0
+        prompt = np.asarray(prompt).reshape(-1)
+        cap = (len(prompt) - 1) // self.page
+        rank = slot // self.Sl
+        self._prefix_lookups += cap
+        chain = self.prefix.match(rank, int(self.aids[slot]), prompt, cap)
+        self._prefix_hits += len(chain)
+        for m, node in enumerate(chain):
+            assert node.partition == self._partition(slot, m)
+            self.allocator.incref(node.partition, node.lid)
+            self.owned[slot][m] = (node.partition, node.lid)
+            self.table[slot, m] = node.lid
+            self._table_dirty = True
+        return len(chain) * self.page
+
+    def register_prefix(self, slot: int, prompt) -> int:
+        """Publish ``slot``'s full prompt pages into the radix tree (page
+        content is a pure function of the token prefix — see module doc).
+        Called once prefill completes; partial tail pages and every page
+        decode will write are excluded by construction."""
+        if self.prefix is None:
+            return 0
+        prompt = np.asarray(prompt).reshape(-1)
+        n = len(prompt) // self.page
+        pages = [self.owned[slot][m] for m in range(n)]
+        rank = slot // self.Sl
+        return self.prefix.register(rank, int(self.aids[slot]), prompt,
+                                    pages, self.allocator)
+
+    def evict_pages(self, partition: int, n: int,
+                    protect: FrozenSet = frozenset()) -> int:
+        """Drop up to ``n`` clean (cache-only) prefix pages from
+        ``partition``; returns how many were actually freed."""
+        if self.prefix is None:
+            return 0
+        return self.prefix.evict(self.allocator, partition, n, protect)
+
+    # -- admission -------------------------------------------------------
+    def fits(self, total_len: int) -> bool:
+        """Could a request of ``total_len`` total positions (prompt +
+        budget) EVER hold its pages alone? Checked at submit so a too-big
+        request is rejected instead of looping through preemption."""
+        n = -(-int(total_len) // self.page)
+        for q in range(self.sp):
+            need = max(0, min(n, (q + 1) * self.Ml) - q * self.Ml)
+            if need > self.pages_per_partition - 1:
+                return False
+        return True
+
+    def admission_check(self, prompt, adapter_id: int,
+                        rank: int) -> Tuple[int, int]:
+        """Free/needed page counts for admitting ``prompt`` on data rank
+        ``rank`` — the pair the scheduler gates on (admit iff ``need <=
+        free``). Counts the pages a fresh insert plus the FIRST decode
+        write would allocate beyond the cached prefix, per seq partition,
+        and tries to evict clean prefix pages where short; returns the
+        binding partition's ``(free, need)``."""
+        prompt = np.asarray(prompt).reshape(-1)
+        T0 = len(prompt)
+        cap = (T0 - 1) // self.page
+        chain = (self.prefix.match(rank, int(adapter_id), prompt, cap,
+                                   touch=False)
+                 if self.prefix is not None else [])
+        need_by_q: Dict[int, int] = {}
+        for m in range(len(chain), T0 // self.page + 1):
+            q = m // self.Ml
+            need_by_q[q] = need_by_q.get(q, 0) + 1
+        protect = frozenset(chain)
+        binding = (0, 0)
+        worst = None
+        for q, need in need_by_q.items():
+            part = rank * self.sp + q
+            free = self.allocator.free_count(part)
+            if free < need:
+                self.evict_pages(part, need - free, protect)
+                free = self.allocator.free_count(part)
+            if worst is None or free - need < worst:
+                worst = free - need
+                binding = (free, need)
+        return binding
+
+    # -- device ops (SlotKVCache surface) --------------------------------
+    def insert(self, slot: int, prompt: np.ndarray,
+               insert_fn=None, pos0: int = 0) -> jnp.ndarray:
+        """Prefill ``prompt`` ``[T0]`` into ``slot`` at positions
+        ``pos0..pos0+T0-1`` through the block table; returns the last REAL
+        position's logits ``[V]``. Validation, bucketing, and semantics
+        match :meth:`SlotKVCache.insert` exactly; ``pos0 > 0`` serves both
+        chunked-prefill continuations and prefix-adopted suffixes (the
+        chunk attends adopted pages through the same gathered view).
+        ``insert_fn`` is accepted for signature compatibility but unused —
+        the paged kernels are dispatched internally."""
+        del insert_fn
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        T0 = prompt.shape[0]
+        pos0 = int(pos0)
+        if not 1 <= T0 <= self.max_len:
+            raise ValueError(f"prompt length {T0} not in [1, {self.max_len}]")
+        if not 0 <= pos0 <= self.max_len - T0:
+            raise ValueError(
+                f"pos0 {pos0} + chunk {T0} exceeds max_len {self.max_len}")
+        Tb = min(bucket_length(T0), self.capacity - pos0)
+        padded = np.zeros((1, Tb), np.int32)
+        padded[0, :T0] = prompt
+        self._ensure_span(slot, pos0, pos0 + T0)
+        table, _ = self._device_tables()
+        if self._ops is not None:
+            last, self.cache = self._ops.insert(
+                self.params, self.cache, table, jnp.asarray(padded),
+                T0 - 1, slot, pos0, int(self.aids[slot]))
+        else:
+            last, self.cache = _paged_insert_kernel(
+                self.model, self.page, self.params, self.cache, table,
+                slot, jnp.asarray(padded), T0 - 1, pos0,
+                jnp.int32(self.aids[slot]))
+        self.pos[slot] = pos0 + T0
+        return last
+
+    def _device_tables(self):
+        """Current device block table + adapter ids, re-uploaded only when
+        host bookkeeping dirtied them (decode-only steps upload nothing)."""
+        if self._table_dirty or self._table_dev is None:
+            if self._ops is not None:
+                self._table_dev = self._ops.upload_table(self.table)
+            else:
+                self._table_dev = jnp.asarray(self.table)
+            self._table_dirty = False
+        if self._aids_dirty or self._aids_dev is None:
+            if self._ops is not None:
+                self._aids_dev = self._ops.upload_aids(self.aids)
+            else:
+                self._aids_dev = jnp.asarray(self.aids)
+            self._aids_dirty = False
+        return self._table_dev, self._aids_dev
+
+    def decode_fn(self, params, cache, tokens, pos, temps, keys, live):
+        """Engine-signature single decode step (the engine calls this
+        exactly like the dense ``_decode_kernel`` partial)."""
+        table, aids = self._device_tables()
+        if self._ops is not None:
+            return self._ops.decode(params, cache, table, aids, tokens,
+                                    pos, temps, keys, live)
+        return _paged_decode_kernel(self.model, self.page, params, cache,
+                                    table, aids, tokens, pos, temps, keys,
+                                    live)
+
+    def fused_fn(self, params, cache, tokens, pos, temps, keys, live,
+                 n_steps: int):
+        """Engine-signature fused multi-step decode."""
+        table, aids = self._device_tables()
+        if self._ops is not None:
+            return self._ops.decode_fused(params, cache, table, aids,
+                                          tokens, pos, temps, keys, live,
+                                          n_steps)
+        return _paged_fused_kernel(self.model, self.page, int(n_steps),
+                                   params, cache, table, aids, tokens,
+                                   pos, temps, keys, live)
+
+    # -- observability / integrity ---------------------------------------
+    def memory_stats(self) -> Dict[str, Any]:
+        """JSON-able snapshot section: page utilization, HBM footprint,
+        prefix-hit ratio, preemption count."""
+        total = self.n_partitions * (self.pages_per_partition - 1)
+        free = sum(self.allocator.free_count(p)
+                   for p in range(self.n_partitions))
+        used = total - free
+        k = self.cache["k"]
+        bytes_ = 2 * int(np.prod(k.shape)) * k.dtype.itemsize
+        return {
+            "page_size": self.page,
+            "pages_per_partition": self.pages_per_partition,
+            "n_partitions": self.n_partitions,
+            "pages_total": total,
+            "pages_used": used,
+            "pages_free": free,
+            "page_utilization": used / total if total else 0.0,
+            "kv_hbm_bytes": bytes_,
+            "preemptions": self.preemptions,
+            "prefix": {
+                "nodes": self.prefix.n_nodes if self.prefix else 0,
+                "hits_pages": self._prefix_hits,
+                "lookups_pages": self._prefix_lookups,
+                "hit_ratio": (self._prefix_hits / self._prefix_lookups
+                              if self._prefix_lookups else 0.0),
+            },
+        }
+
+    def check(self) -> None:
+        """Assert full cross-structure integrity: allocator invariants,
+        refcount == (#owning slots + cache hold) for every page, and
+        table/ownership agreement. Fuzz-test hook."""
+        self.allocator.check()
+        expect: Dict[Tuple[int, int], int] = {}
+        for d in self.owned:
+            for key in d.values():
+                expect[key] = expect.get(key, 0) + 1
+        if self.prefix is not None:
+            for node in self.prefix.nodes():
+                key = (node.partition, node.lid)
+                expect[key] = expect.get(key, 0) + 1
+        for part in range(self.n_partitions):
+            for lid in range(1, self.pages_per_partition):
+                want = expect.get((part, lid), 0)
+                got = self.allocator.refcount(part, lid)
+                assert got == want, \
+                    f"page (p{part}, {lid}): refcount {got} != {want} holders"
+        for s in range(self.n_slots):
+            for m in range(self.M):
+                lid = int(self.table[s, m])
+                if m in self.owned[s]:
+                    part, own_lid = self.owned[s][m]
+                    assert lid == own_lid and part == self._partition(s, m), \
+                        f"table[{s},{m}]={lid} disagrees with ownership"
+                else:
+                    assert lid == 0, \
+                        f"table[{s},{m}]={lid} but page not owned"

@@ -183,13 +183,17 @@ class ServingMetrics:
             "mean": round(sum(vals) / len(vals), 6),
         }
 
-    def snapshot(self, active_slots: int = 0,
-                 queue_depth: int = 0) -> Dict[str, object]:
-        """One JSON-able dict of everything above. The two live gauges are
+    def snapshot(self, active_slots: int = 0, queue_depth: int = 0,
+                 memory: Optional[Dict[str, object]] = None
+                 ) -> Dict[str, object]:
+        """One JSON-able dict of everything above. The live gauges are
         the ENGINE's to report (the metrics object never reaches into the
-        scheduler), so they arrive as arguments."""
+        scheduler), so they arrive as arguments — ``memory`` is the paged
+        engine's page/prefix-cache section
+        (:meth:`~elephas_tpu.serving.memory.PagedKVCache.memory_stats`),
+        included only when provided."""
         fin = list(self._finished)
-        return {
+        out = {
             "engine": {
                 "n_slots": self.n_slots,
                 "active_slots": active_slots,
@@ -223,6 +227,9 @@ class ServingMetrics:
                 "prefill_chunk_stall_s": self._dist(list(self._chunk_stall)),
             },
         }
+        if memory is not None:
+            out["memory"] = memory
+        return out
 
     def to_json(self, **gauges) -> str:
         return json.dumps(self.snapshot(**gauges))

@@ -64,6 +64,11 @@ class ServingRequest:
     next_pos: int = 0              # absolute position `carry` will occupy
     prefill_pos: int = 0           # prompt tokens already inserted (chunked)
     generated: List[int] = field(default_factory=list)
+    # paged-memory state (engine-managed; all inert on the dense path)
+    adapter_id: int = 0            # multi-tenant LoRA variant for this req
+    resume_prompt: Any = None      # prompt ++ generated after a preemption
+    admit_seq: int = -1            # admission stamp (newest is preempted 1st)
+    preemptions: int = 0
 
 
 class Scheduler:
@@ -76,6 +81,9 @@ class Scheduler:
         self._heap: List[Tuple[int, int, ServingRequest]] = []
         self._live = 0                 # heap entries NOT tombstoned
         self._seq = itertools.count()  # FIFO tiebreak within a priority
+        # negative sequence numbers sort BEFORE every FIFO entry of the
+        # same priority: requeued (preempted) work resumes first
+        self._rseq = itertools.count(-1, -1)
 
     def __len__(self) -> int:
         return self._live
@@ -103,6 +111,25 @@ class Scheduler:
             return req
         return None
 
+    def peek(self) -> Optional[ServingRequest]:
+        """The request ``pop`` would return, without removing it (the
+        engine's page-admission check inspects the head's prompt).
+        Tombstones at the front are drained — they are dead entries
+        ``pop`` would skip anyway."""
+        while self._heap and self._heap[0][2].cancelled:
+            heapq.heappop(self._heap)
+        return self._heap[0][2] if self._heap else None
+
+    def requeue(self, req: ServingRequest) -> None:
+        """Put a PREEMPTED request back at the FRONT of its priority class
+        (negative sequence — it beats every FIFO entry), bypassing the
+        ``max_queue`` bound: the request was already admitted once, and
+        rejecting it now would turn backpressure into data loss."""
+        req.cancelled = False
+        heapq.heappush(self._heap,
+                       (-int(req.priority), next(self._rseq), req))
+        self._live += 1
+
     def discard(self, req: ServingRequest) -> bool:
         """Cancel a QUEUED request in O(1): tombstone it, fix the live
         count, leave the heap entry for ``pop`` to skip. Returns False if
@@ -125,7 +152,9 @@ class Scheduler:
 
     def decide(self, free_slots: int, active_slots: int,
                has_partial: bool = False,
-               last_action: Optional[str] = None) -> str:
+               last_action: Optional[str] = None,
+               free_pages: Optional[int] = None,
+               need_pages: Optional[int] = None) -> str:
         """The next engine action: ``"prefill"`` (waiting work + a free
         slot), else ``"decode"`` (any active slot), else ``"idle"``.
 
@@ -137,12 +166,23 @@ class Scheduler:
         while a partial is open (one prompt ingests at a time, so the
         chunk kernel compiles per chunk bucket, not per concurrency
         pattern); with no active rows the chunks just run back-to-back.
+
+        On the paged engine admission is gated by free PAGES, not just
+        free slots: ``need_pages`` is what the queue HEAD would allocate
+        (insert + first decode write, beyond its cached prefix) and
+        ``free_pages`` the binding partition's free count — admission
+        requires ``need_pages <= free_pages``. Only the head is ever
+        considered, so a long-prompt head is never overtaken by cheaper
+        requests behind it: it admits as soon as eviction/releases free
+        its pages (the no-starvation contract, pinned in the tests).
         """
         if has_partial:
             if active_slots > 0 and last_action == "prefill_chunk":
                 return "decode"
             return "prefill_chunk"
-        if self._live and free_slots > 0:
+        if (self._live and free_slots > 0
+                and (free_pages is None or need_pages is None
+                     or need_pages <= free_pages)):
             return "prefill"
         if active_slots > 0:
             return "decode"

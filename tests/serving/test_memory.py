@@ -1,0 +1,260 @@
+"""Paged serving memory, host side: block allocator invariants under
+random churn, radix prefix-cache semantics, and PagedKVCache page
+bookkeeping (the device programs are pinned in test_paged.py).
+
+The fuzz test is the subsystem's safety net: after EVERY operation of a
+random alloc/incref/decref/adopt/register/release/evict schedule, the
+allocator's ``check()`` must hold — no negative refcounts, no leaked
+pages, no page both free and owned — and refcounts must equal exactly
+the references the test itself holds."""
+
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from elephas_tpu.models.transformer import TransformerLM
+from elephas_tpu.serving import (BlockAllocator, PagedKVCache,
+                                 PagesExhausted, RadixPrefixCache)
+
+pytestmark = pytest.mark.serving
+
+V = 17
+
+
+def _model(**kw):
+    cfg = dict(vocab=V, d_model=16, n_heads=4, n_layers=2, d_ff=32,
+               max_len=48)
+    cfg.update(kw)
+    return TransformerLM(**cfg)
+
+
+def _params(model, seed=1):
+    return {k: jnp.asarray(v) for k, v in model.init(seed=seed).items()}
+
+
+# -- block allocator ------------------------------------------------------
+
+def test_allocator_basic_lifecycle():
+    alloc = BlockAllocator(n_partitions=2, pages_per_partition=4)
+    assert alloc.free_count(0) == 3          # page 0 is the pinned trash
+    lid = alloc.alloc(0)
+    assert lid != 0 and alloc.refcount(0, lid) == 1
+    alloc.incref(0, lid)
+    assert alloc.refcount(0, lid) == 2
+    alloc.decref(0, lid)
+    alloc.decref(0, lid)
+    assert alloc.free_count(0) == 3          # back on the free list
+    alloc.check()
+
+
+def test_allocator_exhaustion_and_misuse():
+    alloc = BlockAllocator(n_partitions=1, pages_per_partition=3)
+    a, b = alloc.alloc(0), alloc.alloc(0)
+    with pytest.raises(PagesExhausted) as ei:
+        alloc.alloc(0)
+    assert ei.value.partition == 0 and ei.value.shortfall == 1
+    with pytest.raises(ValueError):
+        alloc.incref(0, 0)                   # trash page is untouchable
+    with pytest.raises(ValueError):
+        alloc.decref(0, 0)
+    alloc.decref(0, a)
+    with pytest.raises(ValueError):
+        alloc.decref(0, a)                   # double free
+    alloc.decref(0, b)
+    alloc.check()
+    with pytest.raises(ValueError):
+        BlockAllocator(n_partitions=0, pages_per_partition=2)
+    with pytest.raises(ValueError):
+        BlockAllocator(n_partitions=1, pages_per_partition=1)
+
+
+def test_allocator_fuzz_invariants_after_every_op():
+    """Random alloc/incref/decref churn, ``check()`` + exact refcount
+    accounting after EVERY operation."""
+    rng = np.random.default_rng(0)
+    parts, pages = 3, 9
+    alloc = BlockAllocator(n_partitions=parts, pages_per_partition=pages)
+    held = []                                # one entry per reference we own
+    for _ in range(2500):
+        op = int(rng.integers(0, 3))
+        if op == 0:
+            part = int(rng.integers(0, parts))
+            try:
+                held.append((part, alloc.alloc(part)))
+            except PagesExhausted as e:
+                assert e.partition == part
+                assert alloc.free_count(part) == 0
+        elif op == 1 and held:
+            part, lid = held[int(rng.integers(len(held)))]
+            alloc.incref(part, lid)
+            held.append((part, lid))
+        elif op == 2 and held:
+            part, lid = held.pop(int(rng.integers(len(held))))
+            alloc.decref(part, lid)
+        alloc.check()
+        counts = Counter(held)
+        for p in range(parts):
+            live = 0
+            for lid in range(1, pages):
+                ref = alloc.refcount(p, lid)
+                assert ref == counts.get((p, lid), 0)
+                live += ref > 0
+            assert alloc.free_count(p) == pages - 1 - live  # nothing leaked
+    for part, lid in held:
+        alloc.decref(part, lid)
+    alloc.check()
+    assert all(alloc.free_count(p) == pages - 1 for p in range(parts))
+
+
+# -- radix prefix cache ---------------------------------------------------
+
+def test_radix_match_register_evict():
+    alloc = BlockAllocator(1, 10)
+    cache = RadixPrefixCache(page=4)
+    toks = np.arange(12, dtype=np.int32)
+    pages = [(0, alloc.alloc(0)) for _ in range(3)]
+    assert cache.register(0, 0, toks, pages, alloc) == 3
+    assert cache.n_nodes == 3
+    for p, lid in pages:                     # registration holds one ref
+        assert alloc.refcount(p, lid) == 2
+    chain = cache.match(0, 0, toks, 3)
+    assert [(n.partition, n.lid) for n in chain] == pages
+    assert len(cache.match(0, 0, toks[:8], 2)) == 2
+    diverged = toks.copy()
+    diverged[5] = 99                          # page 1 differs -> chain stops
+    assert len(cache.match(0, 0, diverged, 3)) == 1
+    # rank and adapter id key separate trees: no cross-tenant sharing
+    assert cache.match(0, 1, toks, 3) == []
+    assert cache.match(1, 0, toks, 3) == []
+    # re-registering identical content creates nothing and keeps the
+    # ORIGINAL pages (the second copy's pages stay the caller's)
+    dup = [(0, alloc.alloc(0)) for _ in range(3)]
+    assert cache.register(0, 0, toks, dup, alloc) == 0
+    for p, lid in dup:
+        assert alloc.refcount(p, lid) == 1
+        alloc.decref(p, lid)
+
+
+def test_radix_evict_lru_leaves_only():
+    alloc = BlockAllocator(1, 10)
+    cache = RadixPrefixCache(page=4)
+    old = np.arange(8, dtype=np.int32)
+    new = np.arange(100, 108, dtype=np.int32)
+    p_old = [(0, alloc.alloc(0)) for _ in range(2)]
+    p_new = [(0, alloc.alloc(0)) for _ in range(2)]
+    cache.register(0, 0, old, p_old, alloc)
+    cache.register(0, 0, new, p_new, alloc)
+    for p, lid in p_old + p_new:             # owner drops its refs: clean
+        alloc.decref(p, lid)
+    cache.match(0, 0, old, 2)                # touch: `old` is now RECENT
+    assert cache.evict(alloc, 0, 1) == 1     # LRU leaf = new's tail page
+    assert len(cache.match(0, 0, new, 2, touch=False)) == 1
+    assert len(cache.match(0, 0, old, 2, touch=False)) == 2
+    # a page still referenced by a slot (refcount > 1) is not evictable,
+    # and it shields its ancestors too (only LEAVES are eviction targets)
+    hot = cache.match(0, 0, old, 2, touch=False)[-1]
+    alloc.incref(hot.partition, hot.lid)
+    assert cache.evict(alloc, 0, 10) == 1    # only new's root is clean+leaf
+    assert cache.n_nodes == 2                # held leaf + its parent survive
+    alloc.decref(hot.partition, hot.lid)
+    assert cache.evict(alloc, 0, 10) == 2    # leaf first, then its parent
+    assert cache.n_nodes == 0
+    alloc.check()
+    assert alloc.free_count(0) == 9
+
+
+def test_radix_evict_respects_protect():
+    alloc = BlockAllocator(1, 6)
+    cache = RadixPrefixCache(page=4)
+    toks = np.arange(8, dtype=np.int32)
+    pages = [(0, alloc.alloc(0)) for _ in range(2)]
+    cache.register(0, 0, toks, pages, alloc)
+    for p, lid in pages:
+        alloc.decref(p, lid)
+    protected = frozenset(cache.match(0, 0, toks, 2, touch=False))
+    assert cache.evict(alloc, 0, 10, protect=protected) == 0
+    assert cache.evict(alloc, 0, 10) == 2
+
+
+# -- PagedKVCache host bookkeeping ---------------------------------------
+
+def test_paged_cache_fits_and_validation():
+    model = _model()
+    kv = PagedKVCache(model, _params(model), n_slots=2, page_size=8,
+                      pages_per_partition=4)
+    assert kv.fits(24)                       # 3 pages <= 3 usable
+    assert not kv.fits(25)                   # 4 pages > 3 usable
+    with pytest.raises(ValueError):          # page must divide the shard
+        PagedKVCache(model, _params(model), n_slots=2, page_size=7)
+
+
+def test_paged_cache_host_churn_fuzz():
+    """Random slot lifecycle (allocate, adopt, span-allocate, register,
+    decode growth, release, evict) against the full cross-check
+    ``PagedKVCache.check()`` after every operation. Host-only: pages move
+    without any device program running."""
+    model = _model()
+    kv = PagedKVCache(model, _params(model), n_slots=4, page_size=8,
+                      pages_per_partition=10)
+    rng = np.random.default_rng(1)
+    live = {}
+    for _ in range(300):
+        op = int(rng.integers(0, 4))
+        if op == 0 and kv.free_slots:
+            slot = kv.allocate()
+            n = int(rng.integers(1, 41))
+            prompt = rng.integers(0, V, size=(n,)).astype(np.int32)
+            kv.set_adapter(slot, 0)
+            adopted = kv.adopt_prefix(slot, prompt)
+            assert adopted <= n - 1          # >=1 real token left to insert
+            try:
+                kv._ensure_span(slot, adopted, n)
+            except PagesExhausted:
+                kv.release(slot)             # mid-way failure: clean undo
+                kv.check()
+                continue
+            kv.pos[slot] = n
+            kv.register_prefix(slot, prompt)
+            live[slot] = n
+        elif op == 1 and live:
+            slot = list(live)[int(rng.integers(len(live)))]
+            kv.release(slot)
+            del live[slot]
+        elif op == 2 and live:
+            slot = list(live)[int(rng.integers(len(live)))]
+            steps = int(rng.integers(1, 4))
+            if live[slot] + steps <= kv.max_len:
+                try:
+                    kv.ensure_decode([slot], steps)
+                except PagesExhausted:
+                    kv.check()
+                    continue
+                for _ in range(steps):
+                    kv.advance(slot)
+                live[slot] += steps
+        else:
+            kv.evict_pages(int(rng.integers(kv.n_partitions)), 2)
+        kv.check()
+    for slot in list(live):
+        kv.release(slot)
+    kv.check()
+    stats = kv.memory_stats()
+    kv.evict_pages(0, stats["pages_total"])
+    assert kv.memory_stats()["pages_used"] == 0
+    kv.check()
+
+
+def test_memory_stats_shape():
+    model = _model()
+    kv = PagedKVCache(model, _params(model), n_slots=2, page_size=8)
+    s = kv.memory_stats()
+    assert s["pages_used"] == 0 and 0.0 <= s["page_utilization"] <= 1.0
+    assert s["kv_hbm_bytes"] > 0
+    assert set(s["prefix"]) == {"nodes", "hits_pages", "lookups_pages",
+                                "hit_ratio"}
+    slot = kv.allocate()
+    kv._ensure_span(slot, 0, 17)             # 3 pages of 8
+    assert kv.memory_stats()["pages_used"] == 3

@@ -1,0 +1,380 @@
+"""Paged serving engine: token identity vs the dense ``SlotKVCache``
+engine, prefix-cache reuse, preemption transparency, page-returning
+cancellation/reaping, page-gated admission, and multi-tenant LoRA.
+
+The acceptance bar mirrors the fast path's: ``paged=True`` must not
+change a single emitted token — greedy or seeded-sampled, local or
+dp×sp-sharded, with or without ``prefill_chunk``/``fuse_k`` — while KV
+HBM scales with live tokens instead of ``slots × max_len``."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from elephas_tpu.models.lora import MultiTenantLM
+from elephas_tpu.models.transformer import TransformerLM, build_mesh_sp
+from elephas_tpu.resilience import FaultPlan
+from elephas_tpu.serving import AdmissionError, ServingEngine
+
+pytestmark = pytest.mark.serving
+
+V = 17
+
+
+def _model(**kw):
+    cfg = dict(vocab=V, d_model=16, n_heads=4, n_layers=2, d_ff=32,
+               max_len=48)
+    cfg.update(kw)
+    return TransformerLM(**cfg)
+
+
+def _params(model, seed=1):
+    return {k: jnp.asarray(v) for k, v in model.init(seed=seed).items()}
+
+
+def _prompts(rng, lens):
+    return [rng.integers(0, V, size=(n,)).astype(np.int32) for n in lens]
+
+
+def _run(eng, reqs, **submit_kw):
+    ids = []
+    for i, (prompt, max_new) in enumerate(reqs):
+        ids.append(eng.submit(prompt, max_new, seed=i, **submit_kw))
+        eng.step()
+    eng.drain(max_steps=5000)
+    return [eng.result(rid).tokens for rid in ids]
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        self.t += 1.0
+        return self.t
+
+
+# -- token identity vs the dense engine ----------------------------------
+
+@pytest.mark.parametrize("page", [8, 16])
+def test_paged_local_identity_greedy_and_sampled(page):
+    """Mixed greedy/sampled batch: the paged engine's streams equal the
+    dense engine's AND per-request ``generate`` (greedy rows)."""
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(0)
+    prompts = _prompts(rng, [5, 11, 23, 3, 17, 9])
+    reqs = [(p, 8) for p in prompts]
+
+    def both(temp):
+        dense = _run(ServingEngine(model, params, n_slots=4), reqs,
+                     temperature=temp)
+        eng = ServingEngine(model, params, n_slots=4, paged=True,
+                            page_size=page)
+        paged = _run(eng, reqs, temperature=temp)
+        eng.kv.check()
+        return dense, paged
+
+    dense, paged = both(0.0)
+    assert dense == paged
+    for prompt, toks in zip(prompts, paged):
+        ref = np.asarray(model.generate(params, prompt[None], 8))
+        assert toks == ref[0, len(prompt):].tolist()
+    dense, paged = both(0.9)
+    assert dense == paged
+
+
+def test_paged_local_identity_chunked_and_fused():
+    """``paged=True`` composes with ``prefill_chunk`` and ``fuse_k``
+    token-identically (the chunk grid may even SHIFT when a prefix hit
+    skips leading pages — the capacity-length reduction makes any chunk
+    decomposition bitwise-equal)."""
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(1)
+    reqs = [(p, 6) for p in _prompts(rng, [20, 3, 26, 17, 9])]
+    dense = _run(ServingEngine(model, params, n_slots=2, prefill_chunk=8,
+                               fuse_k=3), reqs, temperature=0.7)
+    eng = ServingEngine(model, params, n_slots=2, prefill_chunk=8,
+                        fuse_k=3, paged=True, page_size=8)
+    assert dense == _run(eng, reqs, temperature=0.7)
+    assert eng.snapshot()["fastpath"]["prefill_chunks"] >= 4
+    assert eng.snapshot()["fastpath"]["fused_blocks"] >= 1
+    eng.kv.check()
+
+
+def test_paged_sharded_identity():
+    """The dp×sp paged programs (gathered block-table views over the
+    pool sharded ``(data, seq)``) are token-identical to the LOCAL dense
+    engine, plain and with chunked prefill + fused decode."""
+    mesh = build_mesh_sp(data=2, seq=2)
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(2)
+    reqs = [(p, 6) for p in _prompts(rng, [21, 4, 18, 11])]
+
+    local = _run(ServingEngine(model, params, n_slots=4), reqs,
+                 temperature=0.8)
+    eng = ServingEngine(model, params, n_slots=4, mesh=mesh, paged=True,
+                        page_size=8)
+    assert local == _run(eng, reqs, temperature=0.8)
+    eng.kv.check()
+    fast = ServingEngine(model, params, n_slots=4, mesh=mesh, paged=True,
+                         page_size=8, prefill_chunk=8, fuse_k=3)
+    assert local == _run(fast, reqs, temperature=0.8)
+    fast.kv.check()
+
+
+# -- prefix cache ---------------------------------------------------------
+
+def test_prefix_cache_reuse_identity_and_hit_ratio():
+    """Requests sharing a token prefix adopt its pages (skipping their
+    prefill) and STILL emit identical tokens; the snapshot reports the
+    hit ratio."""
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(3)
+    system = rng.integers(0, V, size=(16,)).astype(np.int32)
+    prompts = [np.concatenate([system,
+                               rng.integers(0, V, size=(6,)).astype(np.int32)])
+               for _ in range(6)]
+    reqs = [(p, 6) for p in prompts]
+
+    dense = _run(ServingEngine(model, params, n_slots=2), reqs)
+    eng = ServingEngine(model, params, n_slots=2, paged=True, page_size=8)
+    assert dense == _run(eng, reqs)
+    mem = eng.snapshot()["memory"]
+    # first request is cold; the other five adopt the 2 system pages
+    assert mem["prefix"]["hits_pages"] >= 10
+    assert mem["prefix"]["hit_ratio"] > 0.5
+    # identical RESUBMISSION hits end-to-end and repeats the stream
+    again = _run(eng, reqs, request_id=None)
+    assert again == dense
+    eng.kv.check()
+
+
+def test_prefix_cache_off_still_identical():
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(4)
+    reqs = [(p, 5) for p in _prompts(rng, [9, 21, 13])]
+    dense = _run(ServingEngine(model, params, n_slots=2), reqs)
+    eng = ServingEngine(model, params, n_slots=2, paged=True, page_size=8,
+                        prefix_cache=False)
+    assert dense == _run(eng, reqs)
+    assert eng.snapshot()["memory"]["prefix"]["nodes"] == 0
+
+
+# -- preemption -----------------------------------------------------------
+
+def test_preemption_is_token_transparent():
+    """A pool too small for the co-batch forces preemption (newest
+    victim, requeued at the front); every stream still matches the dense
+    engine exactly — recompute-preemption is invisible in the output."""
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(5)
+    prompts = _prompts(rng, [21, 19, 23, 17])
+    reqs = [(p, 12) for p in prompts]
+    dense = _run(ServingEngine(model, params, n_slots=4), reqs,
+                 temperature=0.8)
+    # each request peaks at ceil((23+12)/8)=5 pages; 11 usable pages
+    # cannot hold 4x5, so page pressure must preempt
+    eng = ServingEngine(model, params, n_slots=4, paged=True, page_size=8,
+                        pages_per_partition=12, prefix_cache=False)
+    assert dense == _run(eng, reqs, temperature=0.8)
+    assert eng.kv.preemptions > 0
+    assert eng.snapshot()["memory"]["preemptions"] > 0
+    eng.kv.check()
+    assert eng.kv.memory_stats()["pages_used"] == 0   # all returned
+
+
+def test_submit_rejects_request_that_never_fits():
+    model = _model()
+    params = _params(model)
+    eng = ServingEngine(model, params, n_slots=2, paged=True, page_size=8,
+                        pages_per_partition=4)      # 3 usable pages = 24 tok
+    with pytest.raises(AdmissionError) as ei:
+        eng.submit(np.zeros(20, np.int32), max_new=8)
+    assert ei.value.reason == "length_exceeds_cache"
+    eng.submit(np.zeros(16, np.int32), max_new=8)   # exactly 3 pages: fine
+
+
+# -- cancellation / deadline chaos ---------------------------------------
+
+def test_cancel_mid_decode_returns_pages():
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(6)
+    prompts = _prompts(rng, [17, 9, 21])
+    eng = ServingEngine(model, params, n_slots=4, paged=True, page_size=8)
+    ids = [eng.submit(p, 12, seed=i, request_id=f"r{i}")
+           for i, p in enumerate(prompts)]
+    for _ in range(8):
+        eng.step()
+    used_before = eng.kv.memory_stats()["pages_used"]
+    assert eng.cancel(ids[1])
+    eng.kv.check()
+    assert eng.kv.memory_stats()["pages_used"] < used_before
+    assert eng.result(ids[1]).finish_reason == "cancelled"
+    eng.drain(max_steps=5000)
+    # survivors are unperturbed: same tokens as per-request generate
+    for i in (0, 2):
+        ref = np.asarray(model.generate(params, prompts[i][None], 12))
+        assert (eng.result(ids[i]).tokens
+                == ref[0, len(prompts[i]):].tolist())
+    eng.kv.check()
+    assert eng.kv.memory_stats()["pages_used"] == \
+        eng.kv.memory_stats()["prefix"]["nodes"]    # only clean cache pages
+    eng.kv.evict_pages(0, 100)
+    assert eng.kv.memory_stats()["pages_used"] == 0
+
+
+def test_chaos_deadline_reaps_decref_shared_prefix():
+    """A ``FaultPlan`` stall kills requests mid-decode via their
+    deadlines; the reaps must return every page INCLUDING decrefs of
+    prefix pages shared with survivors, and the allocator cross-check
+    must hold after each step."""
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(7)
+    system = rng.integers(0, V, size=(16,)).astype(np.int32)
+    prompts = [np.concatenate([system,
+                               rng.integers(0, V, size=(4,)).astype(np.int32)])
+               for _ in range(4)]
+    plan = FaultPlan(serving_stalls={6: 50.0})     # step 6 "takes" 50s
+    eng = ServingEngine(model, params, n_slots=4, paged=True, page_size=8,
+                        clock=FakeClock(), fault_plan=plan)
+    doomed = [eng.submit(prompts[i], 20, request_id=f"d{i}",
+                         deadline_s=30.0) for i in range(2)]
+    safe = [eng.submit(prompts[i], 20, request_id=f"s{i}")
+            for i in (2, 3)]
+    while eng.scheduler.queue_depth or eng.kv.active_slots:
+        eng.step()
+        eng.kv.check()                              # invariants EVERY step
+    for rid in doomed:
+        fin = eng.result(rid)
+        assert fin.finish_reason == "deadline"
+        assert len(fin.tokens) < 20
+    for i, rid in zip((2, 3), safe):
+        ref = np.asarray(model.generate(params, prompts[i][None], 20))
+        assert eng.result(rid).tokens == ref[0, len(prompts[i]):].tolist()
+    # all request-held refs are gone: only clean prefix pages remain
+    stats = eng.kv.memory_stats()
+    assert stats["pages_used"] == stats["prefix"]["nodes"]
+    eng.kv.evict_pages(0, stats["pages_total"])
+    assert eng.kv.memory_stats()["pages_used"] == 0
+    eng.kv.check()
+
+
+# -- page-gated admission (no starvation) --------------------------------
+
+def test_admission_by_free_pages_long_prompt_not_starved():
+    """PINNED no-starvation contract: a long-prompt request at the queue
+    head is never overtaken by cheaper requests behind it — admission
+    gates on the HEAD's page need, so short requests wait until the head
+    admits, even while a slot sits free."""
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(8)
+    eng = ServingEngine(model, params, n_slots=2, paged=True, page_size=8,
+                        pages_per_partition=8, clock=FakeClock())
+    a = eng.submit(rng.integers(0, V, size=(30,)).astype(np.int32), 8,
+                   request_id="a")
+    assert eng.step() == "prefill"                  # a admitted, 4-5 pages
+    long = eng.submit(rng.integers(0, V, size=(33,)).astype(np.int32), 6,
+                      request_id="long")
+    short = eng.submit(rng.integers(0, V, size=(4,)).astype(np.int32), 2,
+                       request_id="short")
+    # the starvation bait: a slot is free and `short` would fit its
+    # pages, but the HEAD (`long`) does not -> the engine must decode,
+    # not admit `short` past it
+    assert eng.kv.free_slots == 1
+    assert eng.step() == "decode"
+    assert eng._requests["long"].slot is None
+    assert eng._requests["short"].slot is None
+    eng.drain(max_steps=5000)
+    fins = {rid: eng.result(rid) for rid in (a, "long", "short")}
+    assert all(f.finish_reason == "length" for f in fins.values())
+    # pinned order: `long` was admitted strictly before `short`
+    assert (fins["long"].timing.admitted_at
+            < fins["short"].timing.admitted_at)
+    for rid, n in (("a", 8), ("long", 6), ("short", 2)):
+        prompt = fins[rid].prompt
+        ref = np.asarray(model.generate(params, prompt[None], n))
+        assert fins[rid].tokens == ref[0, len(prompt):].tolist()
+    eng.kv.check()
+
+
+# -- multi-tenant LoRA ----------------------------------------------------
+
+def test_multi_tenant_adapter0_identity_and_validation():
+    """Adapter 0 (zero-initialized B) equals the plain base model;
+    adapter ids are validated at submit on both engines."""
+    mt = MultiTenantLM(vocab=V, d_model=16, n_heads=4, n_layers=2, d_ff=32,
+                       max_len=48, n_adapters=3, lora_rank=4)
+    mtp = {k: jnp.asarray(v) for k, v in mt.init(seed=1).items()}
+    base = mt.base_model()
+    basep = {k: v for k, v in mtp.items() if not k.startswith("lora_")}
+    rng = np.random.default_rng(9)
+    reqs = [(p, 8) for p in _prompts(rng, [5, 17, 11, 23])]
+    want = _run(ServingEngine(base, basep, n_slots=4), reqs,
+                temperature=0.8)
+    eng = ServingEngine(mt, mtp, n_slots=4, paged=True, page_size=8)
+    assert want == _run(eng, reqs, temperature=0.8, adapter_id=0)
+    with pytest.raises(AdmissionError) as ei:
+        eng.submit(reqs[0][0], 2, adapter_id=3)
+    assert ei.value.reason == "bad_request"
+    dense = ServingEngine(mt, mtp, n_slots=2)
+    with pytest.raises(AdmissionError):
+        dense.submit(reqs[0][0], 2, adapter_id=1)   # dense is single-tenant
+
+
+def test_multi_tenant_cobatch_matches_merged_dense():
+    """Co-batched tenants with DIFFERENT adapters each match a dedicated
+    dense engine running that tenant's merged weights — per-slot adapter
+    selection inside the one decode program is exact, and tenants do not
+    bleed into each other."""
+    mt = MultiTenantLM(vocab=V, d_model=16, n_heads=4, n_layers=2, d_ff=32,
+                       max_len=48, n_adapters=3, lora_rank=4)
+    mtp = mt.init(seed=1)
+    mtp = mt.randomize_adapter(mtp, 1, seed=7)
+    mtp = mt.randomize_adapter(mtp, 2, seed=8)
+    mtp = {k: jnp.asarray(v) for k, v in mtp.items()}
+    base = mt.base_model()
+    rng = np.random.default_rng(10)
+    prompts = _prompts(rng, [21, 19, 23, 17])
+    eng = ServingEngine(mt, mtp, n_slots=4, paged=True, page_size=8)
+    ids = [eng.submit(p, 10, seed=0, request_id=f"r{i}", adapter_id=i % 3)
+           for i, p in enumerate(prompts)]
+    eng.drain(max_steps=5000)
+    for i, (p, rid) in enumerate(zip(prompts, ids)):
+        merged = mt.merged_params(mtp, i % 3)
+        ref = ServingEngine(base, merged, n_slots=1)
+        ref.submit(p, 10, seed=0, request_id="x")
+        ref.drain(max_steps=5000)
+        assert eng.result(rid).tokens == ref.result("x").tokens, i
+    eng.kv.check()
+
+
+# -- observability --------------------------------------------------------
+
+def test_snapshot_memory_section_json_roundtrip():
+    model = _model()
+    params = _params(model)
+    eng = ServingEngine(model, params, n_slots=2, paged=True, page_size=8)
+    rng = np.random.default_rng(11)
+    _run(eng, [(p, 4) for p in _prompts(rng, [9, 13])])
+    snap = json.loads(json.dumps(eng.snapshot()))
+    mem = snap["memory"]
+    assert mem["page_size"] == 8
+    assert 0.0 <= mem["page_utilization"] <= 1.0
+    assert mem["kv_hbm_bytes"] > 0
+    assert mem["pages_used"] + mem["pages_free"] == mem["pages_total"]
+    assert 0.0 <= mem["prefix"]["hit_ratio"] <= 1.0
+    # the dense engine has no memory section (stable schema)
+    dense = ServingEngine(model, params, n_slots=2)
+    assert "memory" not in dense.snapshot()
