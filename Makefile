@@ -7,8 +7,8 @@
 TEST_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 KERAS_BACKEND=jax
 
-.PHONY: test test-fast test-chaos test-perf bench bench-serving bench-paged \
-	bench-lm
+.PHONY: test test-fast test-chaos test-perf test-spec bench bench-serving \
+	bench-paged bench-lm bench-spec
 
 test:
 	$(TEST_ENV) bash scripts/run_tests.sh -x -q
@@ -26,6 +26,11 @@ test-chaos:
 test-perf:
 	ELEPHAS_TEST_GROUP=perf $(TEST_ENV) bash scripts/run_tests.sh -x -q
 
+# Speculative-decoding pins only (draft/verify token identity across
+# dense/paged/mesh/adapters + the metrics schema).
+test-spec:
+	ELEPHAS_TEST_GROUP=spec $(TEST_ENV) bash scripts/run_tests.sh -x -q
+
 bench:
 	KERAS_BACKEND=jax python bench.py
 
@@ -36,6 +41,14 @@ bench-serving:
 	r = {'serving': bench.bench_serving(3), \
 	     'serving_fastpath': bench.bench_serving_fastpath(3)}; \
 	print(json.dumps(r))"
+
+# Speculative-decoding bench only: steady-state decode throughput and
+# acceptance rate at speculate_k vs the single-step baseline, on a
+# high-acceptance (greedy self-draft) and a low-acceptance (n-gram on
+# random tokens) workload.
+bench-spec:
+	KERAS_BACKEND=jax python -c "import json, bench; \
+	print(json.dumps({'spec_decode': bench.bench_spec_decode(3)}))"
 
 # Paged-KV bench only: concurrency at a fixed KV HBM budget (dense slots
 # vs the paged pool) plus the prefix-cache hit ratio.

@@ -74,6 +74,7 @@ from .transformer import (
     paged_scatter_rows,
     select_slot_tokens,
     select_tokens,
+    spec_verify_select,
 )
 
 
@@ -533,6 +534,115 @@ def _chunk_row_sharded(model: TransformerLM, Tl: int, params, row, tokens,
     return last, {"k": kc_new, "v": vc_new}
 
 
+def _verify_rows_sharded(model: TransformerLM, Tl: int, params, kc_all,
+                         vc_all, chunk, pos):
+    """Speculative-verify forward over EVERY local slot row at once:
+    ``chunk`` ``[S, C]`` (carry + drafts per row) at per-row absolute
+    positions ``pos..pos+C-1`` against the local cache slices ``kc_all``/
+    ``vc_all`` ``[L, S, Hkv, Tl, Dh]``. The batched sibling of
+    :func:`_chunk_row_sharded` — same scatter-then-score shape, same
+    global causal/window mask, same ``"seq"`` logsumexp merge, same
+    ``"ring"`` FFN tag — but with NO data-rank owner masking: every rank
+    verifies its OWN slot rows (the verify batch is the whole ``"data"``-
+    sharded slot axis, like the decode step). Chunk writes land at
+    ``pos..pos+C-1`` per row, out-of-slice coordinates dropping on
+    non-owner seq ranks. Returns ``(logits [S, C, V] f32 — replicated
+    across "seq", local to each data rank — new kc_all, new vc_all)``."""
+    S, C = chunk.shape
+    H = model.n_heads
+    Hkv = model.n_kv_heads
+    Dh = model.d_model // H
+    cd = model.compute_dtype
+    r_seq = jax.lax.axis_index(SEQ_AXIS)
+
+    pos_b = pos[:, None] + jnp.arange(C)[None, :]   # [S, C] absolute
+    h = model._embed(params, chunk, pos_b)          # [S, C, D]
+    rope = model._rope_for(pos_b)
+    local_t = pos_b - r_seq * Tl                    # [S, C]
+    write_t = jnp.where((local_t >= 0) & (local_t < Tl), local_t, Tl)
+    slots_g = r_seq * Tl + jnp.arange(Tl)           # [Tl] global pos
+
+    def mask_for(window):
+        # [S, C, Tl]: query j of row s (global pos[s]+j) sees global
+        # slots <= its position, window-clamped below for this layer
+        m = slots_g[None, None, :] <= pos_b[:, :, None]
+        if window is not None:
+            m &= slots_g[None, None, :] > pos_b[:, :, None] - window
+        return m
+
+    def row_write(c, wt, new):
+        # c [Hkv, Tl, Dh]; wt [C]; new [Hkv, C, Dh] — per-row scatter,
+        # out-of-slice coordinates redirected to Tl and dropped
+        return c.at[:, wt, :].set(new, mode="drop")
+
+    def one_layer(h, lp, kc, vc, window):
+        # kc/vc [S, Hkv, Tl, Dh] — this rank's slices of every slot row
+        x = model._norm_h(lp, "ln1", h).astype(cd)
+        q = model._attn_proj(lp, "q", x).reshape(S, C, H, Dh)
+        k_new = model._attn_proj(lp, "k", x).reshape(S, C, Hkv, Dh)
+        v_new = model._attn_proj(lp, "v", x).reshape(S, C, Hkv, Dh)
+        if rope is not None:
+            q = _rope_rotate(q, *rope)
+            k_new = _rope_rotate(k_new, *rope)
+        kc = jax.vmap(row_write)(kc, write_t, k_new.transpose(0, 2, 1, 3))
+        vc = jax.vmap(row_write)(vc, write_t, v_new.transpose(0, 2, 1, 3))
+        qg = q.transpose(0, 2, 1, 3).reshape(S, Hkv, H // Hkv, C, Dh)
+        scores = jnp.einsum(
+            "bkgsd,bktd->bkgst", qg, kc,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        ) * (Dh ** -0.5)
+        scores = jnp.where(mask_for(window)[:, None, None], scores,
+                           -jnp.inf)
+        m_r = jnp.max(scores, axis=-1)              # [S, Hkv, G, C]
+        m = jax.lax.pmax(m_r, SEQ_AXIS)
+        w = jnp.exp(scores - m[..., None])
+        s_r = jnp.sum(w, axis=-1)
+        o_r = jnp.einsum(
+            "bkgst,bktd->bkgsd", w, vc,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        den = jax.lax.psum(s_r, SEQ_AXIS)
+        num = jax.lax.psum(o_r, SEQ_AXIS)
+        a = (num / den[..., None]).astype(cd)       # [S, Hkv, G, C, Dh]
+        a = a.reshape(S, H, C, Dh).transpose(0, 2, 1, 3)
+        h = h + model._attn_proj(lp, "o", a.reshape(S, C, model.d_model))
+        x = model._norm_h(lp, "ln2", h).astype(cd)
+        out, _ = model._ffn(lp, x, "ring", SEQ_AXIS, ep_groups=1)
+        return h + out.astype(cd), kc, vc
+
+    pp = model._window_period()
+
+    def block(h, inputs):
+        lp, kc, vc = inputs
+        if pp == 1:
+            h, kc, vc = one_layer(h, lp, kc, vc, model.attn_windows[0])
+            return h, (kc, vc)
+        kcs, vcs = [], []
+        for g in range(pp):
+            h, kc_g, vc_g = one_layer(
+                h, {k: v[g] for k, v in lp.items()}, kc[g], vc[g],
+                model.attn_windows[g])
+            kcs.append(kc_g)
+            vcs.append(vc_g)
+        return h, (jnp.stack(kcs), jnp.stack(vcs))
+
+    lps = {k: params[k] for k in model._block_keys()}
+    ck, cv = kc_all, vc_all
+    if pp > 1:
+        lps = _period_group(lps, pp)
+        ck = _period_group(ck, pp)
+        cv = _period_group(cv, pp)
+    h, (kc_new, vc_new) = jax.lax.scan(block, h, (lps, ck, cv))
+    if pp > 1:
+        kc_new = _period_ungroup(kc_new, model.n_layers)
+        vc_new = _period_ungroup(vc_new, model.n_layers)
+    h = model._norm_h(params, "lnf", h)
+    logits = model._logits(params, h)               # [S, C, V]
+    return logits, kc_new, vc_new
+
+
 class ServingOps(NamedTuple):
     """The sharded programs the serving engine drives (plus the cache
     factory matching their layout). Signatures are identical to the
@@ -544,6 +654,7 @@ class ServingOps(NamedTuple):
     insert: Any       # (params, cache, tokens[1,Tb], t_last, slot, pos0) -> (last[V], cache)
     decode: Any       # (params, cache, tok[S], pos[S], temps[S], keys[S,2], live[S]) -> (emit[S], tok, pos, cache)
     decode_fused: Any  # (..., live[S], n_steps=K) -> (emit[S,K], tok, pos, cache)
+    verify: Any       # (params, cache, drafts[S,W], tok, pos, temps, keys, live) -> (sel[S,W+1], n[S], tok, pos, cache)
     max_len: int
     capacity: int     # cache time axis = sp · aligned(ceil(max_len / sp))
 
@@ -691,6 +802,20 @@ def build_serving_ops(model: TransformerLM, mesh: Mesh, n_slots: int,
             length=n_steps)
         return emitted.T, tokens, pos, {"k": kc, "v": vc}
 
+    def _verify_impl(params, cache, drafts, tokens, pos, temps, keys, live):
+        # speculative verify: ONE chunk forward scores carry + drafts for
+        # every local row; selection/acceptance runs replicated on every
+        # seq rank from identical merged logits and identical per-slot
+        # keys, so the ranks stay in lockstep (same argument as decode)
+        chunk = jnp.concatenate([tokens[:, None], drafts], axis=1)
+        logits, kc, vc = _verify_rows_sharded(
+            model, Tl, params, cache["k"], cache["v"], chunk, pos)
+        sel, n_acc = spec_verify_select(logits, drafts, pos, temps, keys)
+        corr = jnp.take_along_axis(sel, n_acc[:, None], axis=1)[:, 0]
+        tokens = jnp.where(live, corr, tokens)
+        pos = jnp.where(live, pos + n_acc + 1, pos)
+        return sel, n_acc, tokens, pos, {"k": kc, "v": vc}
+
     insert_programs: Dict[int, Any] = {}
     chunk_programs: Dict[int, Any] = {}
 
@@ -765,9 +890,30 @@ def build_serving_ops(model: TransformerLM, mesh: Mesh, n_slots: int,
         return fused_programs[K](params, cache, tokens, pos, temps, keys,
                                  live)
 
+    verify_programs: Dict[int, Any] = {}
+
+    def verify(params, cache, drafts, tokens, pos, temps, keys, live):
+        W = int(drafts.shape[1])
+        if W not in verify_programs:
+            verify_programs[W] = jax.jit(
+                shard_map(
+                    _verify_impl,
+                    mesh=mesh,
+                    in_specs=(pspecs, cache_specs, P(DATA_AXIS, None))
+                    + state_specs,
+                    out_specs=(P(DATA_AXIS, None), P(DATA_AXIS),
+                               P(DATA_AXIS), P(DATA_AXIS), cache_specs),
+                    check_vma=False,
+                ),
+                donate_argnums=(1,),
+            )
+        return verify_programs[W](params, cache,
+                                  jnp.asarray(drafts, jnp.int32), tokens,
+                                  pos, temps, keys, live)
+
     return ServingOps(init_cache=init_cache, insert=insert, decode=decode,
-                      decode_fused=decode_fused, max_len=max_len,
-                      capacity=capacity)
+                      decode_fused=decode_fused, verify=verify,
+                      max_len=max_len, capacity=capacity)
 
 
 class PagedServingOps(NamedTuple):
@@ -784,6 +930,7 @@ class PagedServingOps(NamedTuple):
     insert: Any        # (params, pool, table, tokens[1,Tb], t_last, slot, pos0, aid) -> (last[V], pool)
     decode: Any        # (params, pool, table, aids, tok, pos, temps, keys, live) -> (emit, tok, pos, pool)
     decode_fused: Any  # (..., live, n_steps=K) -> (emit[S,K], tok, pos, pool)
+    verify: Any        # (params, pool, table, aids, drafts, tok, pos, temps, keys, live) -> (sel, n, tok, pos, pool)
     max_len: int
     capacity: int      # logical per-slot horizon = sp · Tl
     Tl: int            # per-partition time slice
@@ -989,6 +1136,46 @@ def build_paged_serving_ops(model: TransformerLM, mesh: Mesh, n_slots: int,
                                              offs.reshape(S_ * n_steps))
         return emitted.T, tokens_out, pos_out, new_pool
 
+    def _paged_verify_impl(params, pool, table, aids, drafts, tokens, pos,
+                           temps, keys, live):
+        # speculative verify over the pool: dense-view gather, ONE chunk
+        # forward (bitwise the dense verify's math — the view's time axis
+        # equals Tl), then scatter back ONLY the accepted runs' rows; the
+        # rejected tail, non-live rows, and non-owner seq ranks all mask
+        # into the trash page, so rejected tokens leak no page content
+        view = {n: paged_gather_view(pool[n], table, page)
+                for n in ("k", "v")}      # [L, Sl, Hkv, Tl, Dh]
+        chunk = jnp.concatenate([tokens[:, None], drafts], axis=1)
+        with _adapter_ctx(model, aids):
+            logits, kc, vc = _verify_rows_sharded(
+                model, Tl, params, view["k"], view["v"], chunk, pos)
+        sel, n_acc = spec_verify_select(logits, drafts, pos, temps, keys)
+        corr = jnp.take_along_axis(sel, n_acc[:, None], axis=1)[:, 0]
+        r_seq = jax.lax.axis_index(SEQ_AXIS)
+        S_, C = chunk.shape
+        steps = jnp.arange(C)
+        posj = jnp.where(live[:, None], pos[:, None] + steps[None, :],
+                         pos[:, None])                 # [Sl, C]
+        pos_local = posj - r_seq * Tl
+        own_seq = (pos_local >= 0) & (pos_local < Tl)
+        idx = jnp.clip(pos_local, 0, Tl - 1)
+        keep = own_seq & live[:, None] & (steps[None, :] <= n_acc[:, None])
+        pids = jnp.where(keep,
+                         jnp.take_along_axis(table, idx // page, axis=1), 0)
+        offs = idx % page
+        new_pool = {}
+        for n, v in (("k", kc), ("v", vc)):
+            rows = jnp.take_along_axis(
+                v, idx[None, :, None, :, None], axis=3)  # [L,Sl,Hkv,C,Dh]
+            rows = rows.transpose(0, 1, 3, 2, 4).reshape(
+                L, S_ * C, rows.shape[2], rows.shape[4])
+            new_pool[n] = paged_scatter_rows(pool[n], rows,
+                                             pids.reshape(S_ * C),
+                                             offs.reshape(S_ * C))
+        tokens = jnp.where(live, corr, tokens)
+        pos = jnp.where(live, pos + n_acc + 1, pos)
+        return sel, n_acc, tokens, pos, new_pool
+
     insert_programs: Dict[int, Any] = {}
     chunk_programs: Dict[int, Any] = {}
 
@@ -1064,9 +1251,32 @@ def build_paged_serving_ops(model: TransformerLM, mesh: Mesh, n_slots: int,
         return fused_programs[K](params, pool, table, aids, tokens, pos,
                                  temps, keys, live)
 
+    verify_programs: Dict[int, Any] = {}
+
+    def verify(params, pool, table, aids, drafts, tokens, pos, temps, keys,
+               live):
+        W = int(drafts.shape[1])
+        if W not in verify_programs:
+            verify_programs[W] = jax.jit(
+                shard_map(
+                    _paged_verify_impl,
+                    mesh=mesh,
+                    in_specs=(pspecs, pool_specs, table_spec, aids_spec,
+                              P(DATA_AXIS, None)) + state_specs,
+                    out_specs=(P(DATA_AXIS, None), P(DATA_AXIS),
+                               P(DATA_AXIS), P(DATA_AXIS), pool_specs),
+                    check_vma=False,
+                ),
+                donate_argnums=(1,),
+            )
+        return verify_programs[W](params, pool, table, aids,
+                                  jnp.asarray(drafts, jnp.int32), tokens,
+                                  pos, temps, keys, live)
+
     return PagedServingOps(init_pool=init_pool, upload_table=upload_table,
                            upload_aids=upload_aids, insert=insert,
                            decode=decode, decode_fused=decode_fused,
+                           verify=verify,
                            max_len=max_len, capacity=capacity, Tl=Tl,
                            page=page, Ml=Ml,
                            pages_per_partition=Pl, dp=dp, sp=sp)

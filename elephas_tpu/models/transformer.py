@@ -120,12 +120,27 @@ def select_slot_tokens(logits, out_pos, temps, keys):
     same tokens whatever slot it lands in and whatever else is co-batched,
     and the prefill's first token and every decode step share one rule.
     ``temps`` is TRACED (``[S]`` f32), not static — one compiled program
-    serves any mix of greedy and sampled requests."""
+    serves any mix of greedy and sampled requests.
+
+    The sampled branch sits behind a ``lax.cond`` on ``any(temps > 0)``:
+    per-row threefry (``fold_in`` + ``categorical`` over V) is the single
+    most expensive scalar-bound op in a small decode program, and an
+    all-greedy batch — the common serving configuration, and every verify
+    chunk position of one — must not pay for draws it discards. The cond
+    predicate is unbatched, so the speculative verify's vmap over chunk
+    positions keeps it a real branch, not a select of both sides. Outputs
+    are bitwise unchanged: the taken branch IS the previous expression,
+    and with every temp <= 0 the old ``where`` reduced to ``greedy``."""
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    scaled = logits.astype(jnp.float32) / jnp.maximum(temps, 1e-6)[:, None]
-    sk = jax.vmap(jax.random.fold_in)(keys, out_pos)
-    sampled = jax.vmap(jax.random.categorical)(sk, scaled).astype(jnp.int32)
-    return jnp.where(temps > 0, sampled, greedy)
+
+    def _mixed(_):
+        scaled = (logits.astype(jnp.float32)
+                  / jnp.maximum(temps, 1e-6)[:, None])
+        sk = jax.vmap(jax.random.fold_in)(keys, out_pos)
+        sampled = jax.vmap(jax.random.categorical)(sk, scaled)
+        return jnp.where(temps > 0, sampled.astype(jnp.int32), greedy)
+
+    return jax.lax.cond(jnp.any(temps > 0), _mixed, lambda _: greedy, None)
 
 
 def _summed_xent(logits, targets):
@@ -337,6 +352,46 @@ def spec_round_accept(pt, pd_draft, d_toks, u):
     z = jnp.sum(resid, axis=-1, keepdims=True)
     resid = jnp.where(z > 0, resid / jnp.maximum(z, 1e-30), ptn)
     return n, resid
+
+
+def spec_verify_select(logits, drafts, pos, temps, keys):
+    """Serving-side speculative accept/select over one verify chunk:
+    ``logits`` ``[S, C, V]`` (``C = K+1``: carry + K drafts scored in one
+    ``decode_chunk``), ``drafts`` ``[S, K]`` the deterministic proposals,
+    ``pos`` ``[S]`` each row's carry position, ``temps``/``keys`` the
+    per-slot selection state → ``(sel [S, C] int32, n [S] int32)``.
+
+    For every chunk offset ``j``, ``sel[:, j]`` is the token the
+    NON-speculative engine would emit at position ``pos+1+j`` — the exact
+    :func:`select_slot_tokens` rule with the exact ``fold_in(key,
+    position)`` keying — and a draft is accepted while it matches:
+    ``n = Σ cumprod(sel[:, :K] == drafts)``. The correction at the stop
+    slot is ``sel[:, n]`` itself, so the emitted prefix ``sel[:, :n+1]``
+    is BITWISE the sequential stream (greedy and sampled alike): each
+    accepted match feeds the verify chunk the same token the sequential
+    path would have fed its next step, so the next logits row is the same
+    logits the sequential path would have computed, by induction.
+
+    This IS :func:`spec_round_accept`'s distribution-preserving rejection
+    rule specialized to a DETERMINISTIC (delta) proposal and coupled to
+    the engine's ``(seed, position)``-keyed draw stream: with
+    ``p_d = δ_d`` the rule accepts with probability ``min(1, p_t(d)/1)
+    = p_t(d)`` — realized here by drawing ``x ~ p_t`` with the position's
+    own key and accepting iff ``x == d`` — and on rejection the draw
+    ``x | x ≠ d`` is distributed exactly as the clamped residual
+    ``(p_t − δ_d)+ / (1 − p_t(d))``, while a fully-accepted round's bonus
+    draw is ``p_t`` itself. Marginally identical to the PR 1 rule
+    (pinned in tests against :func:`spec_round_accept`), with the bonus
+    property that the coupling makes speculation bitwise invisible."""
+    C = logits.shape[1]
+    K = drafts.shape[1]
+    out_pos = pos[:, None] + 1 + jnp.arange(C)[None, :]        # [S, C]
+    sel = jax.vmap(
+        lambda lg, op: select_slot_tokens(lg, op, temps, keys),
+        in_axes=(1, 1), out_axes=1)(logits, out_pos)           # [S, C]
+    match = (sel[:, :K] == drafts).astype(jnp.int32)
+    n = jnp.sum(jnp.cumprod(match, axis=1), axis=1)            # [S]
+    return sel, n
 
 
 @partial(jax.jit, static_argnames=("target", "draft", "spec_k", "total",

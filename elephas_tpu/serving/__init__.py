@@ -49,7 +49,8 @@ THROUGHPUT, never drift.
 """
 
 from .cache import SlotKVCache
-from .engine import FinishedRequest, ServingEngine
+from .engine import (FinishedRequest, ModelDrafter, NgramDrafter,
+                     ServingEngine)
 from .memory import (BlockAllocator, PagedKVCache, PagesExhausted,
                      RadixPrefixCache)
 from .metrics import ServingMetrics
@@ -59,6 +60,8 @@ __all__ = [
     "AdmissionError",
     "BlockAllocator",
     "FinishedRequest",
+    "ModelDrafter",
+    "NgramDrafter",
     "PagedKVCache",
     "PagesExhausted",
     "RadixPrefixCache",

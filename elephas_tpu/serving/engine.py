@@ -23,7 +23,7 @@ that writes is dead by the staleness-repair invariant (the next
 occupant's prefill overwrites it before anything attends it), and
 position 0 is the cheapest row a masked decode can run.
 
-Three fast-path mechanisms (all OFF by default; every default-config
+Four fast-path mechanisms (all OFF by default; every default-config
 behavior, including greedy/sampled token streams, is unchanged):
 
 - **Chunked prefill** (``prefill_chunk=``): a prompt longer than the
@@ -47,6 +47,18 @@ behavior, including greedy/sampled token streams, is unchanged):
   jitted row-scatter at admission and release, instead of re-uploading
   full mirrors every step. The KV cache is donated through every
   kernel, so on accelerators the multi-GB buffer updates in place.
+- **Speculative decoding** (``speculate_k=``): a cheap drafter proposes
+  ``speculate_k - 1`` tokens per live slot, then ONE fused verify
+  program scores the carry + drafts as a ``decode_chunk`` and accepts
+  each row's longest prefix that matches what the sequential engine
+  would have emitted — the same ``(seed, position)``-keyed selection
+  rule at every chunk position — so up to ``speculate_k`` tokens commit
+  per launch and the emitted stream is BITWISE the non-speculative one
+  (greedy and sampled alike; see
+  :func:`~elephas_tpu.models.transformer.spec_verify_select` for why
+  this is PR 1's distribution-exact accept/resample rule under a
+  deterministic proposer). Speculation stands down to the single-step
+  driver on exactly the conditions that collapse ``_fuse_window``.
 
 Selection is per slot inside the compiled step
 (:func:`~elephas_tpu.models.transformer.select_slot_tokens`): greedy rows
@@ -81,7 +93,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.transformer import select_slot_tokens
+from ..models.transformer import (_adapter_ctx, select_slot_tokens,
+                                  spec_verify_select)
 from .cache import SlotKVCache, bucket_length
 from .memory import PagedKVCache, PagesExhausted
 from .metrics import RequestTiming, ServingMetrics
@@ -124,6 +137,119 @@ def _fused_decode_kernel(model, params, cache, tokens, pos, temps, keys,
     (tokens, pos, cache), emitted = jax.lax.scan(
         body, (tokens, pos, cache), None, length=n_steps)
     return emitted.T, tokens, pos, cache
+
+
+@partial(jax.jit, static_argnames=("model",), donate_argnums=(2,))
+def _verify_kernel(model, params, cache, drafts, tokens, pos, temps, keys,
+                   live):
+    """ONE speculative verify program over every slot: score the carry +
+    ``W`` drafted tokens as a single ``decode_chunk`` (each row's chunk
+    starts at its own ``pos``), select what the sequential engine WOULD
+    emit at all ``W+1`` positions (:func:`spec_verify_select`), and
+    advance live rows past their accepted run + correction in-program.
+    Returns ``(sel [S, W+1], n_accepted [S], tokens, pos, cache)`` —
+    compiled once per draft width, like the fused kernel per ``n_steps``.
+    The chunk's K/V writes land at ``pos..pos+W``; the rejected tail is
+    stale-dead by the staleness-repair invariant (the next round's chunk
+    starts at ``pos + n + 1`` and overwrites it before anything attends
+    it)."""
+    chunk = jnp.concatenate([tokens[:, None], drafts], axis=1)
+    logits, cache = model.decode_chunk(params, chunk, pos, cache)
+    sel, n = spec_verify_select(logits, drafts, pos, temps, keys)
+    corr = jnp.take_along_axis(sel, n[:, None], axis=1)[:, 0]
+    tokens = jnp.where(live, corr, tokens)
+    pos = jnp.where(live, pos + n + 1, pos)
+    return sel, n, tokens, pos, cache
+
+
+@partial(jax.jit, static_argnames=("model", "n_steps"), donate_argnums=(2,))
+def _draft_propose_kernel(model, params, cache, tokens, pos, live, aids,
+                          n_steps: int):
+    """Greedy draft rollout on the DRAFT model's own dense slot cache:
+    ``n_steps`` decode steps from the TARGET's carry/position state (the
+    draft write head always equals the target's committed head at round
+    start), emitting argmax proposals ``[S, n_steps]`` under each row's
+    adapter. The rollout conditions on its own proposals — that is what
+    drafting means — and the cache rows it writes past this round's
+    accepted prefix are overwritten by the next round's rollout before
+    anything attends them (same contiguous-frontier repair as the target
+    cache). Greedy argmax keeps the proposer a delta distribution, which
+    the exact-match acceptance rule requires."""
+    def body(carry, _):
+        tok, p, cache = carry
+        with _adapter_ctx(model, aids):
+            logits, cache = model.decode_step(params, tok, p, cache)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        tok = jnp.where(live, nxt, tok)
+        p = jnp.where(live, p + 1, p)
+        return (tok, p, cache), nxt
+
+    (_, _, cache), drafts = jax.lax.scan(
+        body, (tokens, pos, cache), None, length=n_steps)
+    return drafts.T, cache
+
+
+@partial(jax.jit, static_argnames=("model",), donate_argnums=(2,))
+def _draft_insert_kernel(model, params, cache, tokens, slot, aid):
+    """Prefill the draft cache's ``slot`` row with the (bucket-padded)
+    prompt under the row's adapter — a :class:`MultiTenantLM` draft model
+    serves per-tenant drafters inside the same compiled program. The
+    logits are discarded: the next rollout re-reads the carry the TARGET
+    selected."""
+    with _adapter_ctx(model, jnp.reshape(aid, (1,))):
+        _, cache = model.prefill_slot(params, tokens, slot, cache)
+    return cache
+
+
+class NgramDrafter:
+    """Self-drafting prompt-lookup proposer (host-side, deterministic, no
+    extra parameters): propose the ``k`` tokens that FOLLOWED the most
+    recent earlier occurrence of the context's trailing n-gram (longest
+    ``n`` first), falling back to repeating the last token. Free to run
+    and strong on structured continuations (code, retrieval-grounded
+    text, loops); acceptance on high-entropy text is low, which costs
+    wasted chunk width but never changes the emitted stream — the verify
+    rule is exact under ANY deterministic proposer."""
+
+    def __init__(self, n_max: int = 3):
+        if n_max < 1:
+            raise ValueError(f"n_max must be >= 1, got {n_max}")
+        self.n_max = int(n_max)
+
+    def propose(self, context, k: int) -> np.ndarray:
+        ctx = np.asarray(context, np.int32).reshape(-1)
+        T = ctx.shape[0]
+        out = np.full(k, int(ctx[-1]) if T else 0, np.int32)
+        for n in range(min(self.n_max, T - 1), 0, -1):
+            pat = ctx[T - n:]
+            wins = np.lib.stride_tricks.sliding_window_view(ctx[:-1], n)
+            hits = np.nonzero((wins == pat[None, :]).all(axis=1))[0]
+            if hits.size:
+                s = int(hits[-1])
+                cont = ctx[s + n: s + n + k]
+                out[:cont.size] = cont
+                out[cont.size:] = int(cont[-1])
+                return out
+        return out
+
+
+class ModelDrafter:
+    """Draft-transformer proposer: greedy rollouts from a small model on
+    its OWN dense slot cache (engine-managed), prefilled at admission and
+    advanced in lockstep with the target's committed stream. Pass a
+    :class:`~elephas_tpu.models.lora.MultiTenantLM` to draft per-adapter:
+    each row rolls out under the row's adapter. A non-multi-tenant draft
+    model drafts every tenant with its base weights — acceptance may
+    drop for adapted rows, correctness never depends on the proposer.
+    Local engines only (dense or paged); meshes use the n-gram drafter."""
+
+    def __init__(self, model, params):
+        if model._ring_cache:
+            raise NotImplementedError(
+                "draft model must use a linear (horizon) cache — windowed "
+                "models roll their buffers in prefill_slot")
+        self.model = model
+        self.params = params
 
 
 @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
@@ -170,11 +296,23 @@ class ServingEngine:
                  fault_plan=None, prefill_chunk: Optional[int] = None,
                  fuse_k: int = 1, paged: bool = False, page_size: int = 16,
                  pages_per_partition: Optional[int] = None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True, speculate_k: int = 1,
+                 drafter=None):
         if max_finished < 1:
             raise ValueError(f"max_finished must be >= 1, got {max_finished}")
         if fuse_k < 1:
             raise ValueError(f"fuse_k must be >= 1, got {fuse_k}")
+        if speculate_k < 1:
+            raise ValueError(f"speculate_k must be >= 1, got {speculate_k}")
+        if speculate_k > 1 and getattr(model, "n_experts", 0):
+            raise ValueError(
+                "speculate_k > 1 needs a dense-FFN target: the verify chunk "
+                "re-groups MoE expert dispatch, which breaks the bitwise pin "
+                "against sequential decode")
+        if mesh is not None and isinstance(drafter, ModelDrafter):
+            raise NotImplementedError(
+                "ModelDrafter is local-engine only (its slot cache is "
+                "unsharded); mesh engines speculate with the n-gram drafter")
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(
                 f"prefill_chunk must be >= 1, got {prefill_chunk}")
@@ -195,7 +333,8 @@ class ServingEngine:
         self._skew = 0.0
         self._step_index = 0
         self.scheduler = Scheduler(max_queue=max_queue)
-        self.metrics = ServingMetrics(n_slots=n_slots, window=metrics_window)
+        self.metrics = ServingMetrics(n_slots=n_slots, window=metrics_window,
+                                      spec_k=int(speculate_k))
         self._paged = bool(paged)
         if paged:
             # paged engine: the KV pool + block tables live in PagedKVCache,
@@ -209,6 +348,7 @@ class ServingEngine:
             self._insert_fn = None          # PagedKVCache dispatches inside
             self._decode_fn = self.kv.decode_fn
             self._fused_fn = self.kv.fused_fn
+            self._verify_fn = self.kv.verify_fn
             if mesh is None:
                 state_shardings = [None] * 5
             else:
@@ -223,6 +363,7 @@ class ServingEngine:
             self._insert_fn = None          # SlotKVCache's compiled default
             self._decode_fn = partial(_decode_kernel, model)
             self._fused_fn = partial(_fused_decode_kernel, model)
+            self._verify_fn = partial(_verify_kernel, model)
             state_shardings = [None] * 5
         else:
             # deferred import: sharded_generate is a heavier module and
@@ -237,6 +378,7 @@ class ServingEngine:
             self._insert_fn = ops.insert
             self._decode_fn = ops.decode
             self._fused_fn = ops.decode_fused
+            self._verify_fn = ops.verify
             row = NamedSharding(mesh, P(DATA_AXIS))
             state_shardings = [row, row, row,
                                NamedSharding(mesh, P(DATA_AXIS, None)), row]
@@ -252,6 +394,18 @@ class ServingEngine:
         (self._tok, self._pos, self._temps, self._keys, self._live) = (
             a if sh is None else jax.device_put(a, sh)
             for a, sh in zip(init, state_shardings))
+        # speculative decoding (speculate_k >= 2): drafter + (for a model
+        # drafter) its own dense slot cache, advanced in lockstep with the
+        # target's committed stream
+        self.speculate_k = int(speculate_k)
+        self.drafter = None
+        self._draft_cache = None
+        if self.speculate_k > 1:
+            self.drafter = NgramDrafter() if drafter is None else drafter
+            if isinstance(self.drafter, ModelDrafter):
+                dm = self.drafter.model
+                self._draft_cache = dm.init_cache(S, self.kv.max_len)
+                self._draft_aids = np.zeros(S, np.int32)
         self._partial: Optional[ServingRequest] = None  # open chunk train
         self._last_action: Optional[str] = None
         self._slot_req: Dict[int, ServingRequest] = {}
@@ -364,7 +518,9 @@ class ServingEngine:
             self.kv.free_slots, len(self._slot_req),
             has_partial=self._partial is not None,
             last_action=self._last_action,
-            free_pages=free_pages, need_pages=need_pages)
+            free_pages=free_pages, need_pages=need_pages,
+            reserve_pages=(self._spec_reserve_pages()
+                           if free_pages is not None else 0))
         if action == "prefill":
             req = self.scheduler.pop()
             if req is not None:
@@ -395,6 +551,27 @@ class ServingEngine:
         rank = self.kv._free[-1] // self.kv.Sl
         return self.kv.admission_check(
             self._req_prompt(head), head.adapter_id, rank)
+
+    def _spec_reserve_pages(self) -> int:
+        """Pages the live slots' speculative lookahead may still claim: a
+        verify round writes ``pos..pos+speculate_k-1`` per active slot, so
+        admission must leave those pages claimable — otherwise an accept
+        burst could exhaust the allocator mid-commit, after the verify
+        program already ran (``_ensure_decode_guarded``'s evict/preempt
+        recovery only helps BEFORE the launch). Counts not-yet-owned
+        pages summed across active slots: a cross-partition overestimate
+        of any one partition's exposure, which only makes admission
+        conservative."""
+        if self.speculate_k < 2 or not self._slot_req:
+            return 0
+        page, need = self.kv.page, 0
+        for slot in self._slot_req:
+            p = int(self.kv.pos[slot])
+            lo = p // page
+            hi = min((p + self.speculate_k - 1) // page, self.kv.M - 1)
+            owned = self.kv.owned[slot]
+            need += sum(1 for m in range(lo, hi + 1) if m not in owned)
+        return need
 
     # -- early termination ------------------------------------------------
     def cancel(self, request_id: str) -> bool:
@@ -565,9 +742,33 @@ class ServingEngine:
         if self._paged:
             # publish the now-complete prompt pages for future prefix hits
             self.kv.register_prefix(req.slot, self._req_prompt(req))
+        if isinstance(self.drafter, ModelDrafter):
+            self._draft_prefill(req)
         self._slot_req[req.slot] = req
         self._set_row(req.slot, tok, T0, req.temperature, key, True)
         self._emit(req, tok)
+
+    def _draft_prefill(self, req: ServingRequest) -> None:
+        """(Re)prefill the draft model's slot row with the request's full
+        prompt (resume prompt after a preemption): the draft cache must
+        agree with the target's committed stream before its first rollout.
+        One bucket-padded whole-prompt insert — a drafter is only worth
+        running when it is far cheaper than the target, so its prefill is
+        never chunked."""
+        prompt = self._req_prompt(req)
+        dm = self.drafter
+        cap = int(self._draft_cache["k"].shape[3])
+        T0 = int(prompt.shape[0])
+        Tb = min(bucket_length(T0), cap)
+        padded = np.zeros((1, Tb), np.int32)
+        padded[0, :T0] = prompt
+        aid = (req.adapter_id
+               if req.adapter_id < int(getattr(dm.model, "n_adapters", 1))
+               else 0)
+        self._draft_aids[req.slot] = aid
+        self._draft_cache = _draft_insert_kernel(
+            dm.model, dm.params, self._draft_cache, jnp.asarray(padded),
+            req.slot, jnp.int32(aid))
 
     # -- page pressure (paged engine only) --------------------------------
     def _insert_guarded(self, req: ServingRequest, chunk, pos0: int):
@@ -668,7 +869,98 @@ class ServingEngine:
         return max(1, min(K, min(r.max_new - len(r.generated)
                                  for r in active)))
 
+    def _spec_window(self) -> int:
+        """How many tokens the next decode action may DRAFT (0 = stand
+        down to the non-speculative driver). Bypassed on exactly the
+        conditions that collapse :meth:`_fuse_window` — an open chunk
+        train, any live deadline, a fault plan, or queued work behind an
+        EOS-able active request — plus the budget clamp: a row with ``r``
+        tokens of budget left needs at most ``r - 1`` drafts (its verify
+        chunk emits up to ``drafts + 1``), so the window shrinks to the
+        smallest remaining budget minus one and speculation simply stands
+        down at 0. The clamp also keeps every chunk write inside the
+        cache (``pos + W <= capacity - 1``), so the row-update clamp in
+        ``decode_chunk`` never silently corrupts a tail position."""
+        K = self.speculate_k
+        if (K < 2 or self.fault_plan is not None
+                or self._partial is not None or not self._slot_req):
+            return 0
+        if any(r.deadline_at is not None for r in self._requests.values()):
+            return 0
+        active = self._slot_req.values()
+        if self.scheduler.queue_depth and any(
+                r.eos_id is not None for r in active):
+            return 0
+        return min(K - 1, min(r.max_new - len(r.generated)
+                              for r in active) - 1)
+
+    def _draft_tokens(self, W: int) -> jnp.ndarray:
+        """``[S, W]`` int32 proposals for this round's verify chunk (free
+        rows get zeros — their chunk rows are dead by the staleness-repair
+        invariant). Model drafters roll out on-device from the target's
+        carry/position state; host drafters (``propose(context, k)``) see
+        each request's prompt ++ generated stream, whose last element IS
+        the carry token the chunk starts from."""
+        if isinstance(self.drafter, ModelDrafter):
+            d = self.drafter
+            drafts, self._draft_cache = _draft_propose_kernel(
+                d.model, d.params, self._draft_cache, self._tok, self._pos,
+                self._live, jnp.asarray(self._draft_aids), n_steps=W)
+            return drafts
+        out = np.zeros((self.kv.n_slots, W), np.int32)
+        for slot, req in self._slot_req.items():
+            ctx = np.concatenate([np.asarray(req.prompt, np.int32),
+                                  np.asarray(req.generated, np.int32)])
+            out[slot] = self.drafter.propose(ctx, W)
+        return jnp.asarray(out)
+
+    def _do_decode_spec(self, W: int) -> None:
+        """One speculative round: draft ``W`` tokens per live slot, score
+        carry + drafts in ONE fused verify program, and commit each row's
+        accepted run + correction in bulk. The emitted stream is BITWISE
+        the sequential one — the verify program applies the same ``(seed,
+        position)``-keyed selection at every chunk position and accepts
+        drafts only while they match it — so speculation changes how many
+        program launches the stream costs, never its tokens. Metrics
+        count device-committed tokens (``n_accepted + n_active``); like
+        the fused path, the host stops DELIVERING a row's run at its
+        EOS/budget finish and the leftover device writes are stale-dead."""
+        if self._paged:
+            # every position the chunk may write (pos..pos+W) gets its
+            # page BEFORE the launch: the bulk commit itself cannot fail
+            # (may evict/preempt under pressure — recompute the batch)
+            self._ensure_decode_guarded(W + 1)
+            if not self._slot_req:
+                return
+        n_active = len(self._slot_req)
+        t0 = time.perf_counter()
+        drafts = self._draft_tokens(W)
+        sel, n_acc, self._tok, self._pos, self.kv.cache = self._verify_fn(
+            self.params, self.kv.cache, drafts, self._tok, self._pos,
+            self._temps, self._keys, self._live)
+        t1 = time.perf_counter()
+        toks = np.asarray(sel)
+        n_acc = np.asarray(n_acc)
+        act = list(self._slot_req.items())
+        accepted = sum(int(n_acc[slot]) for slot, _ in act)
+        for slot, req in act:
+            for j in range(int(n_acc[slot]) + 1):
+                if req.request_id not in self._requests:
+                    break
+                # the verify chunk wrote this token's K/V at its position
+                self.kv.advance(slot)
+                req.next_pos += 1
+                self._emit(req, int(toks[slot, j]))
+        self.metrics.observe_spec_round(
+            n_active, n_drafted=n_active * W, n_accepted=accepted,
+            n_emitted=accepted + n_active, block_s=t1 - t0,
+            host_s=time.perf_counter() - t1)
+
     def _do_decode(self) -> None:
+        W = self._spec_window()
+        if W > 0:
+            self._do_decode_spec(W)
+            return
         K = self._fuse_window()
         if self._paged:
             # decode writes land in allocated pages only: grow each active

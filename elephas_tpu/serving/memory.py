@@ -48,7 +48,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..models.transformer import (_adapter_ctx, paged_gather_view,
-                                  paged_scatter_rows, select_slot_tokens)
+                                  paged_scatter_rows, select_slot_tokens,
+                                  spec_verify_select)
 from ..ops.flash_decode import aligned_cache_length
 from .cache import bucket_length
 
@@ -348,6 +349,50 @@ def _paged_fused_kernel(model, page, n_steps, params, pool, table, aids,
                                          pids.reshape(S * K),
                                          offs.reshape(S * K))
     return emitted.T, tokens_out, pos_out, new_pool
+
+
+@partial(jax.jit, static_argnames=("model", "page"), donate_argnums=(3,))
+def _paged_verify_kernel(model, page, params, pool, table, aids, drafts,
+                         tokens, pos, temps, keys, live):
+    """Speculative verify over the paged pool, ONE program: gather every
+    slot's dense view, score carry + ``W`` drafts as a ``decode_chunk``
+    under each row's adapter, accept with the exact-match rule
+    (:func:`~elephas_tpu.models.transformer.spec_verify_select`), and
+    scatter back ONLY the accepted run's K/V rows — the rejected tail
+    (and every non-live row) is MASKED INTO THE TRASH PAGE, so no page
+    churn, copy-on-write, or content divergence leaks from rejected
+    tokens. An accepted position's page bytes are bitwise what a
+    sequential decode would have written there (same view, same inputs),
+    which is what keeps paged ≡ dense under speculation even though the
+    dense path leaves rejected K/V in place as stale-dead rows."""
+    view = {n: paged_gather_view(pool[n], table, page) for n in ("k", "v")}
+    chunk = jnp.concatenate([tokens[:, None], drafts], axis=1)   # [S, C]
+    with _adapter_ctx(model, aids):
+        logits, view = model.decode_chunk(params, chunk, pos, view)
+    sel, n_acc = spec_verify_select(logits, drafts, pos, temps, keys)
+    corr = jnp.take_along_axis(sel, n_acc[:, None], axis=1)[:, 0]
+    S, C = chunk.shape
+    cap = view["k"].shape[3]
+    steps = jnp.arange(C)
+    posj = jnp.where(live[:, None], pos[:, None] + steps[None, :],
+                     pos[:, None])                              # [S, C]
+    idx = jnp.clip(posj, 0, cap - 1)
+    keep = live[:, None] & (steps[None, :] <= n_acc[:, None])
+    pids = jnp.where(keep,
+                     jnp.take_along_axis(table, idx // page, axis=1), 0)
+    offs = idx % page
+    new_pool = {}
+    for n in ("k", "v"):
+        rows = jnp.take_along_axis(
+            view[n], idx[None, :, None, :, None], axis=3)       # [L,S,Hkv,C,Dh]
+        rows = rows.transpose(0, 1, 3, 2, 4).reshape(
+            rows.shape[0], S * C, rows.shape[2], rows.shape[4])
+        new_pool[n] = paged_scatter_rows(pool[n], rows,
+                                         pids.reshape(S * C),
+                                         offs.reshape(S * C))
+    tokens = jnp.where(live, corr, tokens)
+    pos = jnp.where(live, pos + n_acc + 1, pos)
+    return sel, n_acc, tokens, pos, new_pool
 
 
 class PagedKVCache:
@@ -667,6 +712,20 @@ class PagedKVCache:
         return _paged_fused_kernel(self.model, self.page, int(n_steps),
                                    params, cache, table, aids, tokens,
                                    pos, temps, keys, live)
+
+    def verify_fn(self, params, cache, drafts, tokens, pos, temps, keys,
+                  live):
+        """Engine-signature speculative verify: one fused program scoring
+        carry + drafts per slot, committing accepted runs through the
+        block table with the rejected tail trash-masked (see
+        :func:`_paged_verify_kernel`)."""
+        table, aids = self._device_tables()
+        if self._ops is not None:
+            return self._ops.verify(params, cache, table, aids, drafts,
+                                    tokens, pos, temps, keys, live)
+        return _paged_verify_kernel(self.model, self.page, params, cache,
+                                    table, aids, drafts, tokens, pos,
+                                    temps, keys, live)
 
     # -- observability / integrity ---------------------------------------
     def memory_stats(self) -> Dict[str, Any]:

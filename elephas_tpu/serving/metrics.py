@@ -93,6 +93,17 @@ class ServingMetrics:
     fused_blocks: int = 0       # fused multi-step programs launched
     fused_steps: int = 0        # logical steps covered by those blocks
     prefill_chunks: int = 0     # chunk inserts (beyond whole-prompt ones)
+    # speculative decoding: the engine's speculate_k (spec_k == 1 means
+    # the feature is off and the spec section is absent from snapshots)
+    # plus device-committed token accounting per verify round. Pinned
+    # invariant: spec_emitted == spec_accepted + spec_rows (each active
+    # row commits its accepted run plus one correction per round).
+    spec_k: int = 1
+    spec_rounds: int = 0        # draft+verify program launches
+    spec_drafted: int = 0       # drafter proposals scored
+    spec_accepted: int = 0      # proposals matching the engine's rule
+    spec_emitted: int = 0       # tokens committed by verify rounds
+    spec_rows: int = 0          # Σ active rows over verify rounds
     _occupancy_sum: float = 0.0  # Σ (active rows / slots) over decode steps
     _finished: Deque[RequestTiming] = field(default_factory=deque)
     # wall-clock histograms (bounded deques, window entries each). These
@@ -102,6 +113,8 @@ class ServingMetrics:
     _itl: Deque[float] = field(default_factory=deque)       # s per token
     _dispatch: Deque[float] = field(default_factory=deque)  # host s per token
     _chunk_stall: Deque[float] = field(default_factory=deque)  # s per chunk
+    _accept_rate: Deque[float] = field(default_factory=deque)  # per round
+    _spec_tokens: Deque[float] = field(default_factory=deque)  # emitted/row
 
     def observe_reject(self, reason: str) -> None:
         self.rejected[reason] += 1
@@ -147,6 +160,36 @@ class ServingMetrics:
             self._push(self._itl, block_s / n_steps)
         if host_s is not None and n_steps > 0:
             self._push(self._dispatch, host_s / n_steps)
+
+    def observe_spec_round(self, n_active: int, n_drafted: int,
+                           n_accepted: int, n_emitted: int,
+                           block_s: Optional[float] = None,
+                           host_s: Optional[float] = None) -> None:
+        """One speculative draft+verify round over ``n_active`` live rows:
+        ``n_drafted`` proposals were scored in the fused verify program,
+        ``n_accepted`` matched the engine's selection rule, and
+        ``n_emitted = n_accepted + n_active`` tokens were committed (each
+        row's accepted run plus its correction). A round counts ONE
+        logical decode step — occupancy stays per-launch, and the spec
+        counters carry the real multi-token accounting. ``block_s``
+        spreads over the tokens the round emitted per row, so the
+        inter-token-latency histogram directly shows the speculative
+        speedup; ``host_s`` likewise (drafting cost included by the
+        caller)."""
+        self.spec_rounds += 1
+        self.spec_drafted += int(n_drafted)
+        self.spec_accepted += int(n_accepted)
+        self.spec_emitted += int(n_emitted)
+        self.spec_rows += int(n_active)
+        self.observe_decode_step(n_active)
+        if n_drafted > 0:
+            self._push(self._accept_rate, n_accepted / n_drafted)
+        if n_active > 0 and n_emitted > 0:
+            self._push(self._spec_tokens, n_emitted / n_active)
+            if block_s is not None:
+                self._push(self._itl, block_s * n_active / n_emitted)
+            if host_s is not None:
+                self._push(self._dispatch, host_s * n_active / n_emitted)
 
     def observe_prefill_chunk(self, n_tokens: int, stalled_slots: int,
                               chunk_s: Optional[float] = None) -> None:
@@ -227,6 +270,19 @@ class ServingMetrics:
                 "prefill_chunk_stall_s": self._dist(list(self._chunk_stall)),
             },
         }
+        if self.spec_k > 1:
+            # speculative section: present IFF the engine speculates, so
+            # dashboards key feature detection off the snapshot itself
+            out["fastpath"].update({
+                "spec_rounds": self.spec_rounds,
+                "spec_drafted": self.spec_drafted,
+                "spec_accepted": self.spec_accepted,
+                "spec_emitted": self.spec_emitted,
+                "spec_rows": self.spec_rows,
+                "acceptance_rate": self._dist(list(self._accept_rate)),
+                "emitted_per_row_per_round": self._dist(
+                    list(self._spec_tokens)),
+            })
         if memory is not None:
             out["memory"] = memory
         return out

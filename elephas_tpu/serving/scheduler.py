@@ -154,7 +154,8 @@ class Scheduler:
                has_partial: bool = False,
                last_action: Optional[str] = None,
                free_pages: Optional[int] = None,
-               need_pages: Optional[int] = None) -> str:
+               need_pages: Optional[int] = None,
+               reserve_pages: int = 0) -> str:
         """The next engine action: ``"prefill"`` (waiting work + a free
         slot), else ``"decode"`` (any active slot), else ``"idle"``.
 
@@ -175,6 +176,14 @@ class Scheduler:
         considered, so a long-prompt head is never overtaken by cheaper
         requests behind it: it admits as soon as eviction/releases free
         its pages (the no-starvation contract, pinned in the tests).
+
+        ``reserve_pages`` holds back pages the LIVE slots may still
+        claim — on a speculating engine, each active slot's next verify
+        round can commit up to ``speculate_k`` tokens at once, and those
+        pages must stay claimable or an accept burst hits an
+        unrecoverable allocator failure mid-commit. Admitting by the
+        head's need alone (the pre-reservation bug) let a new prompt eat
+        exactly the pages a burst needed.
         """
         if has_partial:
             if active_slots > 0 and last_action == "prefill_chunk":
@@ -182,7 +191,8 @@ class Scheduler:
             return "prefill_chunk"
         if (self._live and free_slots > 0
                 and (free_pages is None or need_pages is None
-                     or need_pages <= free_pages)):
+                     or need_pages + max(0, int(reserve_pages))
+                     <= free_pages)):
             return "prefill"
         if active_slots > 0:
             return "decode"

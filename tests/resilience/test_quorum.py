@@ -39,7 +39,7 @@ def test_sync_quorum_commits_despite_two_dead_workers(spark_context,
     loss_before = float(model.evaluate(x, y, verbose=0)[0])
 
     plan = FaultPlan(seed=3, dead_partitions=[2, 5])
-    registry = HeartbeatRegistry(lease_s=120.0)
+    registry = HeartbeatRegistry(lease_s=120.0, clock=lambda: 0.0)
     sm = SparkModel(model, mode="synchronous", num_workers=8, comm="host",
                     fault_plan=plan, membership=registry, quorum=6)
     sm.fit(to_simple_rdd(spark_context, x, y), epochs=1, batch_size=16,
@@ -72,7 +72,9 @@ def test_sync_quorum_lost_raises(spark_context, quorum_data):
     model = make_classifier(hidden=4, optimizer="sgd")
     sm = SparkModel(model, mode="synchronous", num_workers=4, comm="host",
                     fault_plan=FaultPlan(seed=0, dead_partitions=[1]),
-                    membership=HeartbeatRegistry(lease_s=120.0), quorum=4)
+                    membership=HeartbeatRegistry(lease_s=120.0,
+                                                 clock=lambda: 0.0),
+                    quorum=4)
     with pytest.raises(QuorumLostError):
         sm.fit(to_simple_rdd(spark_context, x[:200], y[:200]), epochs=1,
                batch_size=16, verbose=0, validation_split=0.0, shuffle=False)
@@ -83,7 +85,12 @@ def test_jax_membership_mask_excludes_expired_worker(spark_context,
     """Fused-program path: a member the registry saw die is masked out of
     every merge denominator (engine ``worker_valid``), geometry unchanged."""
     x, y = quorum_data
-    registry = HeartbeatRegistry(lease_s=120.0)
+    # frozen clock: lease expiry can NEVER fire from wall time, so the only
+    # expired member is the one the test expires explicitly — this pins the
+    # mask deterministically on loaded/slow CI hosts (the historical flake:
+    # a straggling executor's heartbeat aged past the lease mid-fit and the
+    # mask grew a second zero)
+    registry = HeartbeatRegistry(lease_s=120.0, clock=lambda: 0.0)
     model = make_classifier(hidden=8, optimizer="sgd")
     loss_before = float(model.evaluate(x, y, verbose=0)[0])
     sm = SparkModel(model, mode="synchronous", num_workers=4, comm="jax",
@@ -97,7 +104,7 @@ def test_jax_membership_mask_excludes_expired_worker(spark_context,
     assert sm._membership_mask(4) == [1.0, 1.0, 1.0, 0.0]
 
     sm.fit(to_simple_rdd(spark_context, x[:200], y[:200]), epochs=2,
-           batch_size=16, verbose=0, validation_split=0.0)
+           batch_size=16, verbose=0, validation_split=0.0, shuffle=False)
     for w in model.get_weights():
         assert np.all(np.isfinite(np.asarray(w)))
     loss_after = float(model.evaluate(x[:200], y[:200], verbose=0)[0])
@@ -105,7 +112,7 @@ def test_jax_membership_mask_excludes_expired_worker(spark_context,
 
 
 def test_jax_membership_mask_quorum_lost():
-    registry = HeartbeatRegistry(lease_s=120.0)
+    registry = HeartbeatRegistry(lease_s=120.0, clock=lambda: 0.0)
     model = make_classifier(hidden=4, optimizer="sgd")
     sm = SparkModel(model, mode="synchronous", num_workers=4, comm="jax",
                     membership=registry, quorum=3)

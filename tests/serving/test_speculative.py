@@ -1,0 +1,347 @@
+"""Speculative decoding on the serving fast path: draft K, verify once.
+
+The acceptance property is the same one every fast-path knob here pins:
+turning speculation on (``speculate_k > 1``) must not change a single
+emitted token — greedy or seeded-sampled, dense or paged, local or
+sharded, any drafter. Speculation is allowed to change ONLY how many
+device programs the stream costs, never the stream. The verify rule is
+exact-match against the engine's own per-slot selection
+(:func:`~elephas_tpu.models.transformer.spec_verify_select`), which makes
+the identity bitwise rather than distributional — so these tests compare
+token lists directly instead of statistics.
+"""
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from elephas_tpu.models.transformer import (TransformerLM, build_mesh_sp,
+                                            spec_verify_select)
+from elephas_tpu.models.lora import MultiTenantLM
+from elephas_tpu.serving import (AdmissionError, ModelDrafter, NgramDrafter,
+                                 ServingEngine)
+from elephas_tpu.serving.scheduler import Scheduler
+
+pytestmark = [pytest.mark.serving, pytest.mark.spec]
+
+V = 17
+
+
+def _model(**kw):
+    cfg = dict(vocab=V, d_model=16, n_heads=4, n_layers=2, d_ff=32,
+               max_len=48)
+    cfg.update(kw)
+    return TransformerLM(**cfg)
+
+
+def _params(model, seed=1):
+    return {k: jnp.asarray(v) for k, v in model.init(seed=seed).items()}
+
+
+def _run(eng, reqs, **submit_kw):
+    ids = []
+    for i, (prompt, max_new) in enumerate(reqs):
+        ids.append(eng.submit(prompt, max_new, seed=i, **submit_kw))
+        eng.step()
+    eng.drain(max_steps=5000)
+    return [eng.result(rid).tokens for rid in ids]
+
+
+def _prompts(rng, lens):
+    return [rng.integers(0, V, size=(n,)).astype(np.int32) for n in lens]
+
+
+@pytest.fixture(scope="module")
+def base_case():
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(0)
+    prompts = _prompts(rng, [5, 9, 3, 7])
+    return model, params, [(p, 12) for p in prompts]
+
+
+# -- the selection rule itself --------------------------------------------
+
+def test_verify_select_equals_sequential_selection():
+    """``spec_verify_select`` applied to a [S, K+1, V] chunk of logits
+    must pick, at every chunk offset, EXACTLY the token the one-at-a-time
+    engine rule (``select_slot_tokens`` keyed on absolute position) picks —
+    greedy rows and sampled rows alike. This is the lemma the whole
+    bitwise-identity claim rests on: given it, induction over accepted
+    prefixes makes the emitted stream the sequential stream."""
+    import jax
+    from elephas_tpu.models.transformer import select_slot_tokens
+    rng = np.random.default_rng(3)
+    S, K = 4, 3
+    logits = jnp.asarray(rng.normal(size=(S, K + 1, V)).astype(np.float32))
+    drafts = jnp.asarray(rng.integers(0, V, size=(S, K)).astype(np.int32))
+    pos = jnp.asarray(np.array([2, 7, 0, 5], np.int32))
+    temps = jnp.asarray(np.array([0.0, 0.9, 0.0, 1.3], np.float32))
+    keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(S, dtype=jnp.uint32))
+    sel, n = spec_verify_select(logits, drafts, pos, temps, keys)
+    sel = np.asarray(sel)
+    for j in range(K + 1):
+        step = np.asarray(
+            select_slot_tokens(logits[:, j], pos + 1 + j, temps, keys))
+        assert (sel[:, j] == step).all(), j
+    # the acceptance count is the longest drafts-match-selection prefix
+    want_n = np.zeros(S, np.int64)
+    for s in range(S):
+        while (want_n[s] < K
+               and sel[s, want_n[s]] == int(drafts[s, want_n[s]])):
+            want_n[s] += 1
+    assert (np.asarray(n) == want_n).all()
+
+
+def test_ngram_drafter_proposes_repeats():
+    """The self-drafting n-gram drafter finds the most recent prior
+    occurrence of the longest context suffix and proposes its historical
+    continuation; with no history it repeats the last token. Pure host
+    numpy — deterministic by construction."""
+    d = NgramDrafter(n_max=3)
+    ctx = np.asarray([4, 5, 6, 9, 4, 5, 6], np.int32)
+    # suffix (5, 6) last occurred at index 1 → continuation 9, then 4, 5
+    assert d.propose(ctx, 3).tolist() == [9, 4, 5]
+    # continuation shorter than k pads with its own last token
+    assert d.propose(np.asarray([7, 8, 7], np.int32), 4).tolist() == \
+        [8, 7, 7, 7]
+    # no repeated suffix anywhere: repeat the tail
+    assert d.propose(np.asarray([1], np.int32), 2).tolist() == [1, 1]
+
+
+# -- token identity, every engine configuration ---------------------------
+
+def test_spec_greedy_identity_and_engagement(base_case):
+    """Greedy speculative decoding (default n-gram drafter) is token-
+    identical to the non-speculative engine AND to per-request
+    ``generate`` — and the rounds actually ran (an accidentally dead
+    feature would pass identity trivially)."""
+    model, params, reqs = base_case
+    want = _run(ServingEngine(model, params, n_slots=4), reqs)
+    eng = ServingEngine(model, params, n_slots=4, speculate_k=4)
+    assert _run(eng, reqs) == want
+    fp = eng.snapshot()["fastpath"]
+    assert fp["spec_rounds"] > 0
+    for (prompt, n), toks in zip(reqs, want):
+        ref = np.asarray(model.generate(params, prompt[None], n))
+        assert toks == ref[0, len(prompt):].tolist()
+
+
+def test_spec_sampled_identity(base_case):
+    """Seeded sampling: the (seed, absolute-position) keying of the
+    verify selection makes the sampled stream bitwise the sequential
+    one — acceptance never rewinds or replays a random draw."""
+    model, params, reqs = base_case
+    want = _run(ServingEngine(model, params, n_slots=4), reqs,
+                temperature=0.9)
+    eng = ServingEngine(model, params, n_slots=4, speculate_k=4)
+    assert _run(eng, reqs, temperature=0.9) == want
+    assert eng.snapshot()["fastpath"]["spec_rounds"] > 0
+
+
+def test_spec_model_drafter_identity_high_acceptance(base_case):
+    """A greedy self-draft (the target model as its own drafter) under a
+    greedy target mostly accepts, and the stream is still the pinned one.
+    Acceptance is HIGH but deliberately not pinned at 100%: the drafter
+    argmaxes ``decode_step`` logits while verify scores a ``decode_chunk``,
+    and the two programs may reassociate float ops differently — at a
+    near-tie the argmax flips, the exact-match rule rejects, and the
+    emitted stream is STILL exactly the sequential one (which is the
+    property that matters). Also pins drafter-independence: n-gram and
+    model drafters produce the SAME tokens."""
+    model, params, reqs = base_case
+    want = _run(ServingEngine(model, params, n_slots=4), reqs)
+    eng = ServingEngine(model, params, n_slots=4, speculate_k=4,
+                        drafter=ModelDrafter(model, params))
+    assert _run(eng, reqs) == want
+    fp = eng.snapshot()["fastpath"]
+    assert fp["spec_rounds"] > 0
+    assert fp["spec_accepted"] >= 0.7 * fp["spec_drafted"]
+
+
+def test_spec_paged_bitwise_dense(base_case):
+    """Paged speculation (accepted-run scatter, rejected tail into the
+    trash page) is token-identical to dense speculation and to the
+    non-speculative stream; the pool passes its integrity check after."""
+    model, params, reqs = base_case
+    want = _run(ServingEngine(model, params, n_slots=4), reqs)
+    eng = ServingEngine(model, params, n_slots=4, speculate_k=4,
+                        paged=True, page_size=8)
+    assert _run(eng, reqs) == want
+    assert eng.snapshot()["fastpath"]["spec_rounds"] > 0
+    eng.kv.check()
+
+
+def test_spec_mesh_identity(base_case):
+    """The sharded verify program (seq-sharded cache, merged logits
+    replicated across ranks) emits the same greedy and sampled streams as
+    the local engine, dense and paged."""
+    model, params, reqs = base_case
+    mesh = build_mesh_sp(data=2, seq=2)
+    want = _run(ServingEngine(model, params, n_slots=4), reqs)
+    eng = ServingEngine(model, params, n_slots=4, mesh=mesh, speculate_k=4)
+    assert _run(eng, reqs) == want
+    assert eng.snapshot()["fastpath"]["spec_rounds"] > 0
+    paged = ServingEngine(model, params, n_slots=4, mesh=mesh,
+                          speculate_k=4, paged=True, page_size=8)
+    assert _run(paged, reqs) == want
+    want_s = _run(ServingEngine(model, params, n_slots=4), reqs,
+                  temperature=0.8)
+    eng_s = ServingEngine(model, params, n_slots=4, mesh=mesh,
+                          speculate_k=4)
+    assert _run(eng_s, reqs, temperature=0.8) == want_s
+
+
+def test_spec_multi_tenant_adapters(base_case):
+    """Per-adapter speculation on the paged multi-tenant engine: each
+    co-batched tenant's speculative stream equals a dedicated dense
+    NON-speculative engine running that tenant's merged weights."""
+    mt = MultiTenantLM(vocab=V, d_model=16, n_heads=4, n_layers=2, d_ff=32,
+                       max_len=48, n_adapters=3, lora_rank=4)
+    mtp = mt.init(seed=1)
+    mtp = mt.randomize_adapter(mtp, 1, seed=7)
+    mtp = mt.randomize_adapter(mtp, 2, seed=8)
+    mtp = {k: jnp.asarray(v) for k, v in mtp.items()}
+    base = mt.base_model()
+    rng = np.random.default_rng(10)
+    prompts = _prompts(rng, [21, 19, 23, 17])
+    eng = ServingEngine(mt, mtp, n_slots=4, paged=True, page_size=8,
+                        speculate_k=4)
+    ids = [eng.submit(p, 10, seed=0, request_id=f"r{i}", adapter_id=i % 3)
+           for i, p in enumerate(prompts)]
+    eng.drain(max_steps=5000)
+    assert eng.snapshot()["fastpath"]["spec_rounds"] > 0
+    for i, (p, rid) in enumerate(zip(prompts, ids)):
+        merged = mt.merged_params(mtp, i % 3)
+        ref = ServingEngine(base, merged, n_slots=1)
+        ref.submit(p, 10, seed=0, request_id="x")
+        ref.drain(max_steps=5000)
+        assert eng.result(rid).tokens == ref.result("x").tokens, i
+    eng.kv.check()
+
+
+def test_spec_eos_truncates_mid_round(base_case):
+    """A row that hits EOS inside an accepted run stops emitting there —
+    finish reason and token list match the sequential engine exactly (the
+    device keeps committing the rest of the round; only host emission
+    truncates, same contract as the fused path)."""
+    model, params, reqs = base_case
+    base = ServingEngine(model, params, n_slots=4)
+    want_ids = [base.submit(p, n, seed=i, eos_id=2)
+                for i, (p, n) in enumerate(reqs)]
+    base.drain(max_steps=5000)
+    want = [base.result(r) for r in want_ids]
+    assert any(f.finish_reason == "eos" for f in want), \
+        "fixture no longer exercises EOS; pick a different eos_id"
+    eng = ServingEngine(model, params, n_slots=4, speculate_k=4)
+    got_ids = [eng.submit(p, n, seed=i, eos_id=2)
+               for i, (p, n) in enumerate(reqs)]
+    eng.drain(max_steps=5000)
+    for rid, ref in zip(got_ids, want):
+        got = eng.result(rid)
+        assert got.tokens == ref.tokens
+        assert got.finish_reason == ref.finish_reason
+
+
+def test_spec_stands_down_for_deadlines(base_case):
+    """Any live deadline forces the engine back to single-step decode
+    (the same contract as fusion: a deadline must be observable every
+    logical step) — zero speculative rounds, stream unchanged."""
+    model, params, reqs = base_case
+    want = _run(ServingEngine(model, params, n_slots=4), reqs)
+    eng = ServingEngine(model, params, n_slots=4, speculate_k=4)
+    ids = [eng.submit(p, n, seed=i, deadline_s=1e9)
+           for i, (p, n) in enumerate(reqs)]
+    eng.drain(max_steps=5000)
+    assert [eng.result(r).tokens for r in ids] == want
+    assert eng.snapshot()["fastpath"]["spec_rounds"] == 0
+
+
+# -- construction validation ----------------------------------------------
+
+def test_spec_validation(base_case):
+    model, params, _ = base_case
+    with pytest.raises(ValueError):
+        ServingEngine(model, params, n_slots=2, speculate_k=0)
+    mesh = build_mesh_sp(data=2, seq=2)
+    with pytest.raises(NotImplementedError):
+        ServingEngine(model, params, n_slots=2, mesh=mesh, speculate_k=4,
+                      drafter=ModelDrafter(model, params))
+    from elephas_tpu.models.transformer import MoETransformerLM
+    moe = MoETransformerLM(vocab=V, d_model=16, n_heads=4, n_layers=1,
+                           d_ff=32, max_len=48, n_experts=4, k=2)
+    moep = _params(moe)
+    with pytest.raises(ValueError):
+        ServingEngine(moe, moep, n_slots=2, speculate_k=4)
+    # speculate_k=1 on an MoE model is fine: the feature is off
+    ServingEngine(moe, moep, n_slots=2, speculate_k=1)
+
+
+# -- scheduler page reservation (satellite) --------------------------------
+
+def test_scheduler_reserves_speculative_lookahead_pages():
+    """``decide`` must hold back the live slots' accept-burst page
+    exposure: the head admits only when its pages AND the reservation
+    both fit. The pre-reservation behavior (admit on head need alone) is
+    the bug this pins out."""
+    from elephas_tpu.serving.scheduler import ServingRequest
+    s = Scheduler()
+    s.push(ServingRequest(request_id="q", prompt=np.zeros(4, np.int32),
+                          max_new=4))
+    common = dict(free_slots=1, active_slots=3, free_pages=5, need_pages=4)
+    assert s.decide(**common) == "prefill"                      # no reserve
+    assert s.decide(**common, reserve_pages=1) == "prefill"     # 4+1 <= 5
+    assert s.decide(**common, reserve_pages=2) == "decode"      # 4+2 > 5
+    # negative reservations are clamped, not credited
+    assert s.decide(**common, reserve_pages=-3) == "prefill"
+    # with no paged accounting at all, reserve_pages is inert
+    assert s.decide(free_slots=1, active_slots=0,
+                    reserve_pages=99) == "prefill"
+
+
+# -- metrics schema (satellite) --------------------------------------------
+
+def test_spec_metrics_schema_and_consistency(base_case):
+    """The ``fastpath`` spec section is present IFF ``speculate_k > 1``,
+    and its counters obey the pinned accounting identities."""
+    model, params, reqs = base_case
+    off = ServingEngine(model, params, n_slots=4)
+    _run(off, reqs)
+    fp_off = off.snapshot()["fastpath"]
+    for key in ("spec_rounds", "spec_drafted", "spec_accepted",
+                "spec_emitted", "spec_rows", "acceptance_rate",
+                "emitted_per_row_per_round"):
+        assert key not in fp_off, key
+
+    eng = ServingEngine(model, params, n_slots=4, speculate_k=4)
+    _run(eng, reqs)
+    fp = eng.snapshot()["fastpath"]
+    assert fp["spec_rounds"] > 0
+    # every verify round commits each row's accepted run + one correction
+    assert fp["spec_emitted"] == fp["spec_accepted"] + fp["spec_rows"]
+    # drafts per round per row never exceed the lookahead window
+    assert fp["spec_accepted"] <= fp["spec_drafted"]
+    assert fp["spec_drafted"] <= fp["spec_rows"] * (eng.speculate_k - 1)
+    # the histograms are dist dicts like every other fastpath histogram
+    for key in ("acceptance_rate", "emitted_per_row_per_round"):
+        assert set(fp[key]) == {"count", "p50", "p95", "mean"}
+    assert fp["acceptance_rate"]["count"] == fp["spec_rounds"]
+    # a spec round is ONE logical decode step: fused counters untouched
+    assert fp["fused_blocks"] == 0
+    import json
+    json.dumps(eng.snapshot())  # the whole snapshot stays JSON-able
+
+
+def test_no_wall_clock_reads_outside_perf_counter():
+    """The engine and metrics modules must never read ``time.time`` —
+    latency histograms use ``time.perf_counter`` and request lifecycle
+    stamps use the injectable engine clock. A ``time.time`` crept in once
+    and broke fake-clock latency pins; this keeps it out."""
+    from elephas_tpu.serving import engine as engine_mod
+    from elephas_tpu.serving import metrics as metrics_mod
+    for mod in (engine_mod, metrics_mod):
+        src = Path(mod.__file__).read_text()
+        assert "time.time(" not in src, mod.__name__
