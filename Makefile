@@ -7,8 +7,8 @@
 TEST_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 KERAS_BACKEND=jax
 
-.PHONY: test test-fast test-chaos test-perf test-spec bench bench-serving \
-	bench-paged bench-lm bench-spec
+.PHONY: test test-fast test-chaos test-perf test-spec test-streaming \
+	bench bench-serving bench-paged bench-lm bench-spec
 
 test:
 	$(TEST_ENV) bash scripts/run_tests.sh -x -q
@@ -30,6 +30,12 @@ test-perf:
 # dense/paged/mesh/adapters + the metrics schema).
 test-spec:
 	ELEPHAS_TEST_GROUP=spec $(TEST_ENV) bash scripts/run_tests.sh -x -q
+
+# Streaming train-to-serve pins only (hot weight rollover replay identity,
+# publication cadence/eval-gate/rollback, version piggyback parity,
+# supervised stream crash-resume determinism).
+test-streaming:
+	ELEPHAS_TEST_GROUP=streaming $(TEST_ENV) bash scripts/run_tests.sh -x -q
 
 bench:
 	KERAS_BACKEND=jax python bench.py

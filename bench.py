@@ -1137,6 +1137,114 @@ def bench_failover(reps: int):
     }
 
 
+def bench_streaming(reps: int):
+    """Live weight rollover tax on the serving decode loop.
+
+    CPU-runnable. The streaming pipeline's headline question is what hot
+    ``swap_params`` costs the engine it publishes into: steady-state decode
+    tokens/sec with NO swaps vs a rollover every N decode rounds (two
+    parameter versions cycled, the publisher's worst case — every publish
+    actually changes the weights). The swap itself is host-side pointer
+    surgery (no retrace: same shapes/dtypes hit the same compiled step), so
+    the ratio should sit near 1.0; a regression here means the swap started
+    invalidating compiled state. The rolling run is also replayed with the
+    identical version schedule and asserted token- AND attribution-identical,
+    pinning the determinism contract under measurement, not just in tests.
+
+    Skip with BENCH_STREAMING=0; swap cadence via BENCH_STREAM_SWAP_EVERY;
+    geometry shares BENCH_SERVE_FAST_{DMODEL,LAYERS,VOCAB,NEW} with the
+    fastpath bench (same dispatch-bound-regime reasoning).
+    """
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    if os.environ.get("BENCH_STREAMING", "1") == "0":
+        log("streaming bench: skipped (BENCH_STREAMING=0)")
+        return None
+
+    from elephas_tpu.models import TransformerLM
+    from elephas_tpu.serving import ServingEngine
+
+    def knob(name, default):
+        return int(os.environ.get(f"BENCH_SERVE_{name.upper()}", default))
+
+    d_model = knob("fast_dmodel", 64)
+    n_layers = knob("fast_layers", 2)
+    n_heads = max(1, d_model // 64)
+    vocab = knob("fast_vocab", 512)
+    prompt_len = knob("prompt", 16)
+    max_new = knob("fast_new", 64)
+    slots = 8
+    swap_every = int(os.environ.get("BENCH_STREAM_SWAP_EVERY", 4))
+    model = TransformerLM(
+        vocab=vocab, d_model=d_model, n_heads=n_heads, n_layers=n_layers,
+        d_ff=4 * d_model, max_len=prompt_len + max_new,
+        pos_encoding="rotary", tie_embeddings=True,
+    )
+    versions = [
+        {k: jnp.asarray(v) for k, v in model.init(seed=s).items()}
+        for s in (0, 1)
+    ]
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, vocab, size=(prompt_len,)).astype(np.int32)
+               for _ in range(slots)]
+
+    def rolling_run(every):
+        """Admit everything, then time decode-to-empty with a publication
+        every ``every`` decode rounds (0 = static). Returns (decode
+        tokens/sec, per-request (tokens, token_versions), swaps)."""
+        eng = ServingEngine(model, versions[0], n_slots=slots)
+        ids = [eng.submit(p, max_new) for p in prompts]
+        while eng.kv.free_slots:        # one prefill per step
+            eng.step()
+        t0 = time.perf_counter()
+        steps = 0
+        while eng._requests:
+            eng.step()
+            steps += 1
+            if every and steps % every == 0:
+                # alternate versions: every publish really changes weights
+                eng.swap_params(versions[(steps // every) % 2])
+        dt = time.perf_counter() - t0
+        fin = {r: eng.result(r) for r in ids}
+        outs = [(fin[r].tokens, list(fin[r].token_versions)) for r in ids]
+        return slots * (max_new - 1) / dt, outs, eng.metrics.weight_swaps
+
+    log(f"streaming: slots={slots} swap_every={swap_every} (compiling...)")
+    rolling_run(0)                      # warmup/compile
+    best_static, best_roll, swaps = 0.0, 0.0, 0
+    roll_out = None
+    for rep in range(max(1, reps)):
+        r_static, _, _ = rolling_run(0)
+        r_roll, o_roll, swaps = rolling_run(swap_every)
+        log(f"streaming rep {rep}: static {r_static:,.0f} tok/s, "
+            f"rolling {r_roll:,.0f} tok/s ({swaps} swaps)")
+        best_static = max(best_static, r_static)
+        if r_roll > best_roll:
+            best_roll, roll_out = r_roll, o_roll
+    # determinism pin: replaying the same version schedule reproduces the
+    # tokens AND the per-token attribution, under measurement conditions
+    _, replay_out, _ = rolling_run(swap_every)
+    for (got_t, got_v), (want_t, want_v) in zip(replay_out, roll_out):
+        np.testing.assert_array_equal(got_t, want_t)
+        assert got_v == want_v
+    out = {
+        "swap_every": swap_every,
+        "static_tok_s": round(best_static, 1),
+        "rolling_tok_s": round(best_roll, 1),
+        "throughput_ratio": round(best_roll / best_static, 3),
+        "weight_swaps": swaps,
+        "replay_identical": True,
+        "config": (f"d{d_model}xL{n_layers}xH{n_heads}-V{vocab}"
+                   f"-p{prompt_len}n{max_new}-s{slots}"),
+    }
+    log(f"streaming: rollover every {swap_every} rounds keeps "
+        f"{out['throughput_ratio']:.3f}x of static decode throughput")
+    return out
+
+
 def make_model(input_dim, nb_classes):
     import keras
 
@@ -1332,6 +1440,16 @@ def main():
         failover = None
     if failover is not None:
         result["failover"] = failover
+        print(json.dumps(result), flush=True)
+
+    # -- streaming phase: hot weight rollover tax (CPU-runnable) ----------
+    try:
+        streaming = bench_streaming(reps)
+    except Exception as e:
+        log(f"streaming bench failed: {type(e).__name__}: {e}")
+        streaming = None
+    if streaming is not None:
+        result["streaming"] = streaming
         print(json.dumps(result), flush=True)
 
     # -- LM phase: FLOPs-accounted tokens/sec + MFU on the same chip ------

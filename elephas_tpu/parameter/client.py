@@ -201,6 +201,11 @@ class SocketClient(BaseParameterClient):
         # deserializes before returning)
         self._rxbuf = socket_utils.ReusableBuffer()
         self.last_seen_version = -1
+        # Versioned-pull capability (opcode b"G" → (version, weights)).
+        # Probed optimistically on the first pull; a legacy server closes
+        # the connection on the unknown opcode, which degrades this client
+        # to plain b"g" pulls (version piggyback off, like pre-header HTTP).
+        self._versioned_pull = True
 
     def _ensure(self) -> socket.socket:
         if self._sock is None:
@@ -238,12 +243,38 @@ class SocketClient(BaseParameterClient):
                 raise
 
     def get_parameters(self) -> List[np.ndarray]:
-        def op(sock):
+        def op_versioned(sock):
+            sock.sendall(b"G")
+            return socket_utils.receive(sock, buf=self._rxbuf)
+
+        def op_legacy(sock):
             sock.sendall(b"g")
             return socket_utils.receive(sock, buf=self._rxbuf)
 
         with self._lock:
-            return self._roundtrip(op)
+            if self._versioned_pull:
+                try:
+                    version, weights = self._roundtrip(op_versioned)
+                except socket.timeout:
+                    raise
+                except (ConnectionError, OSError):
+                    # Either a legacy server closed on the unknown opcode
+                    # (no versioned-pull API) or the server is down — the
+                    # plain pull distinguishes: it succeeds against a
+                    # legacy server (stay degraded) and fails against a
+                    # dead one (restore the probe so a recovered modern
+                    # server gets its version piggyback back).
+                    self._versioned_pull = False
+                    self._reset()
+                    try:
+                        return self._roundtrip(op_legacy)
+                    except (ConnectionError, OSError):
+                        self._versioned_pull = True
+                        raise
+                self.last_seen_version = max(self.last_seen_version,
+                                             int(version))
+                return weights
+            return self._roundtrip(op_legacy)
 
     def get_version(self) -> int:
         def op(sock):

@@ -28,7 +28,7 @@ import http.server
 import pickle
 import socket
 import threading
-from typing import Any, List, Optional
+from typing import Any, List, Optional, Tuple
 
 import numpy as np
 
@@ -282,6 +282,20 @@ class BaseParameterServer:
             self.fault_plan.delay_server_pull()  # injected slow read
         return self.weights
 
+    def get_versioned_weights(self) -> Tuple[int, List[np.ndarray]]:
+        """Atomic ``(version, weights)`` pair for a versioned pull. Read
+        under the same lock ``apply_delta`` mutates under, so the stamp
+        can never be off-by-one from the weights it describes (hogwild
+        mode reads lock-free, exactly as its plain pulls always have —
+        racy by that mode's contract)."""
+        self._check_alive()
+        if self.fault_plan is not None:
+            self.fault_plan.delay_server_pull()  # injected slow read
+        if self.mode == "hogwild":
+            return self.version, self.weights
+        with self.lock:
+            return self.version, self.weights
+
     def start(self) -> None:
         raise NotImplementedError
 
@@ -470,6 +484,13 @@ class SocketServer(BaseParameterServer):
                     break
                 if op == b"g":
                     socket_utils.send(conn, self.get_weights())
+                elif op == b"G":
+                    # versioned pull: one atomic (version, weights) pair —
+                    # the socket transport's answer to HTTP's
+                    # X-Elephas-Version header (a legacy server hits the
+                    # `else: break` below and closes, which the client
+                    # reads as "no versioned-pull API" and degrades)
+                    socket_utils.send(conn, self.get_versioned_weights())
                 elif op == b"u":
                     delta = socket_utils.receive(conn, buf=rxbuf)
                     self.apply_delta(delta)

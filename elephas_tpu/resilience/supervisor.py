@@ -101,6 +101,40 @@ class TrainingSupervisor:
         """Train to ``epochs`` total epochs, surviving up to
         ``max_restarts`` crashes. Raises :class:`SupervisorAborted` when
         the budget runs out."""
+        self._supervise(
+            lambda resume: self._run_fit(rdd, epochs, resume, fit_kwargs),
+            {"epochs": epochs},
+        )
+
+    def fit_stream(self, batches, trainer, *, publisher=None,
+                   checkpoint_every: Optional[int] = None) -> None:
+        """Drain a finite micro-batch stream through ``trainer``
+        (:class:`~elephas_tpu.streaming.trainer.StreamTrainer`), surviving
+        crashes the same way :meth:`fit` does. The checkpoint carries the
+        CURSOR (batches consumed) plus the publisher's JSON state and the
+        current PS master weights; on resume, already-committed batches
+        are skipped — exactly-once consumption — so the parameter server's
+        version history (and therefore the publisher's publish/rollback
+        history) replays deterministically at a fixed seed. The PS itself
+        is assumed to outlive the driver-side crash (it holds the
+        authoritative weights); the checkpointed weights exist for the
+        cold-restart case where the PS must be reseeded too.
+
+        ``checkpoint_every`` defaults to ``checkpoint_frequency``
+        (commits, not epochs, in this mode)."""
+        batches = list(batches)
+        every = (self.checkpoint_frequency if checkpoint_every is None
+                 else int(checkpoint_every))
+        if every < 1:
+            raise ValueError("checkpoint_every must be >= 1")
+        self._supervise(
+            lambda resume: self._run_stream(batches, trainer, publisher,
+                                            every, resume),
+            {"batches": len(batches)},
+        )
+
+    def _supervise(self, attempt: Callable[[bool], None],
+                   complete_info: Dict[str, Any]) -> None:
         while True:
             resume = has_checkpoint(self.checkpoint_dir)
             self._emit(
@@ -108,7 +142,7 @@ class TrainingSupervisor:
                 detail=self.checkpoint_dir if resume else "",
             )
             try:
-                self._run_fit(rdd, epochs, resume, fit_kwargs)
+                attempt(resume)
             except (KeyboardInterrupt, SystemExit):
                 raise
             except Exception as err:
@@ -127,7 +161,7 @@ class TrainingSupervisor:
                 if pause > 0.0:
                     self.restart_policy.sleep(pause)
                 continue
-            self._emit("complete", epochs=epochs)
+            self._emit("complete", **complete_info)
             return
 
     # -- one attempt ------------------------------------------------------
@@ -161,3 +195,41 @@ class TrainingSupervisor:
                 [np.asarray(w) for w in network.get_weights()],
                 {"epoch": epoch, "epochs": epochs, "mode": self.model.mode},
             )
+
+    # -- one streaming attempt --------------------------------------------
+    def _run_stream(self, batches, trainer, publisher, every: int,
+                    resume: bool) -> None:
+        start = 0
+        if resume:
+            _weights, meta, _ = load_checkpoint(self.checkpoint_dir)
+            stream = meta.get("stream", {})
+            start = int(stream.get("batches_done", 0))
+            trainer.commits = int(stream.get("commits", trainer.commits))
+            if publisher is not None and stream.get("publisher") is not None:
+                publisher.load_state_dict(stream["publisher"],
+                                          weights=_weights)
+        done = start
+        for i, batch in enumerate(batches):
+            if i < start:
+                continue  # committed before the crash: never re-applied
+            commit = trainer.step(batch, index=i)
+            if publisher is not None:
+                publisher.offer(commit)
+            done = i + 1
+            if done % every == 0:
+                self._checkpoint_stream(trainer, publisher, done)
+        if done % every != 0 or done == start:
+            self._checkpoint_stream(trainer, publisher, done)
+
+    def _checkpoint_stream(self, trainer, publisher, done: int) -> None:
+        weights = [np.asarray(w) for w in trainer.client.get_parameters()]
+        meta: Dict[str, Any] = {
+            "mode": "stream",
+            "stream": {
+                "batches_done": int(done),
+                "commits": int(trainer.commits),
+                "publisher": (None if publisher is None
+                              else publisher.state_dict()),
+            },
+        }
+        save_checkpoint(self.checkpoint_dir, weights, meta)

@@ -127,6 +127,15 @@ class SlotKVCache:
         self.pos[slot] = 0
         self._free.append(slot)
 
+    # -- weight rollover --------------------------------------------------
+    def set_params(self, params) -> None:
+        """Swap the weights future PREFILL INSERTS run under (decode steps
+        take params from the engine per launch). Pure host reassignment:
+        the params pytree has the same shapes/dtypes, so the compiled
+        insert kernels never retrace, and params are never donated, so no
+        kernel can be holding a donated alias of the old tree."""
+        self.params = params
+
     # -- device ops ------------------------------------------------------
     def insert(self, slot: int, prompt: np.ndarray,
                insert_fn=None, pos0: int = 0) -> jnp.ndarray:

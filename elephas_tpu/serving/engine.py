@@ -85,7 +85,7 @@ from __future__ import annotations
 
 import itertools
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional
 
@@ -274,13 +274,23 @@ def _select_first(last, t0, temp, key):
 @dataclass
 class FinishedRequest:
     """Terminal record handed back by :meth:`ServingEngine.result` /
-    :meth:`ServingEngine.drain`."""
+    :meth:`ServingEngine.drain`.
+
+    ``token_versions[i]`` is the weights version live at the decode round
+    that emitted ``tokens[i]`` — every token is attributable to exactly
+    ONE version, and version boundaries fall only between rounds.
+    ``version_first``/``version_last`` summarize the stream's span (equal
+    unless a hot swap landed mid-request; ``-1`` on a request cancelled
+    before its first token)."""
 
     request_id: str
     prompt: np.ndarray            # [T0] int32
     tokens: List[int]             # generated continuation (EOS included)
     finish_reason: str            # "eos" | "length" | "deadline" | "cancelled"
     timing: RequestTiming
+    token_versions: List[int] = field(default_factory=list)
+    version_first: int = -1
+    version_last: int = -1
 
 
 class ServingEngine:
@@ -406,6 +416,14 @@ class ServingEngine:
                 dm = self.drafter.model
                 self._draft_cache = dm.init_cache(S, self.kv.max_len)
                 self._draft_aids = np.zeros(S, np.int32)
+        # weight rollover: the monotonic-ish version stamp of the weights
+        # currently serving (0 until the first swap; a rollback republishes
+        # an OLDER stamp) and the drafter-staleness flag — a ModelDrafter
+        # whose params were NOT swapped with the target's stands down until
+        # fresh drafter params arrive (acceptance would crater, and the
+        # drafter must never speculate against weights it has not seen).
+        self.weights_version = 0
+        self._drafter_stale = False
         self._partial: Optional[ServingRequest] = None  # open chunk train
         self._last_action: Optional[str] = None
         self._slot_req: Dict[int, ServingRequest] = {}
@@ -573,6 +591,64 @@ class ServingEngine:
             need += sum(1 for m in range(lo, hi + 1) if m not in owned)
         return need
 
+    # -- weight rollover ---------------------------------------------------
+    def swap_params(self, params, version: Optional[int] = None,
+                    drafter_params=None) -> int:
+        """Hot-swap the serving weights WITHOUT draining slots; returns
+        the new :attr:`weights_version`.
+
+        Call between ``step()`` calls (the engine is host-driven, so any
+        caller on the driver thread already is): every decode round runs
+        entirely under one params tree, which is what makes each emitted
+        token attributable to exactly one version and keeps version
+        boundaries on round boundaries. The swap is donation-safe and
+        retrace-free on every fast path — the decode/fused/verify/insert
+        kernels donate only the KV cache (params are plain arguments), and
+        the new tree has the same shapes/dtypes, so compiled programs are
+        reused as-is. In-flight requests keep their slots, carries, and
+        K/V; their next round simply runs under the new weights (prompt
+        K/V written under older versions stays — attribution is by
+        EMISSION round, and a replay applying the same version schedule at
+        the same rounds reproduces the stream token-for-token).
+
+        ``version`` stamps the new weights (default: previous + 1). A
+        ROLLBACK republishes an older version with its original stamp —
+        the stamp records what is serving, not a sequence number.
+
+        Per-knob behavior:
+
+        - paged: the radix prefix cache is flushed (its pages hold K/V
+          computed under the old weights); live slots keep their own page
+          references, so nothing in flight is disturbed.
+        - speculative + :class:`ModelDrafter`: pass ``drafter_params`` to
+          swap the drafter ATOMICALLY with the target; without it the
+          drafter STANDS DOWN (the engine decodes non-speculatively, still
+          token-identical) until a later swap supplies fresh drafter
+          params. Host drafters (:class:`NgramDrafter`) are parameterless
+          and keep speculating — the verify rule is exact under any
+          proposer, so correctness never depends on the drafter's weights.
+        """
+        if drafter_params is not None and not isinstance(self.drafter,
+                                                         ModelDrafter):
+            raise ValueError(
+                "drafter_params passed but the engine has no ModelDrafter "
+                "to swap them into")
+        self.params = params
+        self.kv.set_params(params)   # prefill inserts; paged: flush prefixes
+        if isinstance(self.drafter, ModelDrafter):
+            if drafter_params is not None:
+                # atomic target+drafter swap: the draft cache's old-version
+                # K/V only dents acceptance (verify is exact), and the next
+                # rollout overwrites the frontier it actually uses
+                self.drafter.params = drafter_params
+                self._drafter_stale = False
+            else:
+                self._drafter_stale = True
+        self.weights_version = (self.weights_version + 1 if version is None
+                                else int(version))
+        self.metrics.observe_swap(self.weights_version)
+        return self.weights_version
+
     # -- early termination ------------------------------------------------
     def cancel(self, request_id: str) -> bool:
         """Terminate a queued or in-flight request NOW: its slot (if any)
@@ -613,10 +689,7 @@ class ServingEngine:
         req.timing.generated_tokens = len(req.generated)
         req.timing.finish_reason = reason
         self.metrics.observe_cancel(reason)
-        self._file_finished(FinishedRequest(
-            request_id=req.request_id, prompt=req.prompt,
-            tokens=list(req.generated), finish_reason=reason,
-            timing=req.timing))
+        self._file_finished(self._terminal_record(req, reason))
 
     def drain(self, max_steps: Optional[int] = None
               ) -> Dict[str, FinishedRequest]:
@@ -638,6 +711,16 @@ class ServingEngine:
         if pop:
             return self._finished.pop(request_id, None)
         return self._finished.get(request_id)
+
+    @staticmethod
+    def _terminal_record(req: ServingRequest, reason: str) -> FinishedRequest:
+        versions = list(req.token_versions)
+        return FinishedRequest(
+            request_id=req.request_id, prompt=req.prompt,
+            tokens=list(req.generated), finish_reason=reason,
+            timing=req.timing, token_versions=versions,
+            version_first=versions[0] if versions else -1,
+            version_last=versions[-1] if versions else -1)
 
     def _file_finished(self, fin: FinishedRequest) -> None:
         """Record a terminal request, evicting the OLDEST retained results
@@ -685,6 +768,7 @@ class ServingEngine:
         slot = self.kv.allocate()
         req.timing.admitted_at = self._now()
         req.slot = slot
+        req.prefill_version = self.weights_version
         self.metrics.observe_prefill()
         prompt = self._req_prompt(req)
         T0 = int(prompt.shape[0])
@@ -739,8 +823,12 @@ class ServingEngine:
         req.next_pos = T0           # position `tok` occupies
         if req.timing.first_token_at is None:   # preserve TTFT on resume
             req.timing.first_token_at = self._now()
-        if self._paged:
-            # publish the now-complete prompt pages for future prefix hits
+        if self._paged and req.prefill_version == self.weights_version:
+            # publish the now-complete prompt pages for future prefix hits.
+            # A prompt whose (chunked) prefill SPANNED a swap is excluded:
+            # its pages hold mixed-version K/V, and the prefix cache's
+            # contract — page content is a pure function of the token
+            # prefix — only holds within one weight version.
             self.kv.register_prefix(req.slot, self._req_prompt(req))
         if isinstance(self.drafter, ModelDrafter):
             self._draft_prefill(req)
@@ -882,7 +970,7 @@ class ServingEngine:
         cache (``pos + W <= capacity - 1``), so the row-update clamp in
         ``decode_chunk`` never silently corrupts a tail position."""
         K = self.speculate_k
-        if (K < 2 or self.fault_plan is not None
+        if (K < 2 or self.fault_plan is not None or self._drafter_stale
                 or self._partial is not None or not self._slot_req):
             return 0
         if any(r.deadline_at is not None for r in self._requests.values()):
@@ -999,8 +1087,12 @@ class ServingEngine:
             host_s=time.perf_counter() - t1)
 
     def _emit(self, req: ServingRequest, tok: int) -> None:
-        """Deliver one generated token: record, stream, finish/continue."""
+        """Deliver one generated token: record, stream, finish/continue.
+        The token is stamped with the CURRENT weights version — the
+        version every program of this decode round ran under (swaps only
+        happen between host-driven rounds), so attribution is exact."""
         req.generated.append(tok)
+        req.token_versions.append(self.weights_version)
         done_eos = req.eos_id is not None and tok == req.eos_id
         done_len = len(req.generated) >= req.max_new
         done = done_eos or done_len
@@ -1012,10 +1104,8 @@ class ServingEngine:
         req.timing.generated_tokens = len(req.generated)
         req.timing.finish_reason = "eos" if done_eos else "length"
         self.metrics.observe_finish(req.timing)
-        self._file_finished(FinishedRequest(
-            request_id=req.request_id, prompt=req.prompt,
-            tokens=list(req.generated),
-            finish_reason=req.timing.finish_reason, timing=req.timing))
+        self._file_finished(
+            self._terminal_record(req, req.timing.finish_reason))
         slot = req.slot
         self._slot_req.pop(slot, None)
         self._requests.pop(req.request_id, None)

@@ -592,6 +592,36 @@ class PagedKVCache:
             return 0
         return self.prefix.evict(self.allocator, partition, n, protect)
 
+    # -- weight rollover --------------------------------------------------
+    def flush_prefixes(self) -> int:
+        """Drop EVERY cached prefix page (the cache's own references only)
+        and return how many were released. Cached pages hold K/V computed
+        under the weights that prefilled them, so a weight swap must
+        invalidate the whole tree — "page content is a pure function of
+        the token prefix" only holds per weight version. Live slots keep
+        their own refcounts on any pages they adopted, so in-flight
+        requests are untouched; their pages return to the free pool at
+        release."""
+        if self.prefix is None:
+            return 0
+        flushed = 0
+        for node in list(self.prefix.nodes()):
+            self.allocator.decref(node.partition, node.lid)
+            flushed += 1
+        self.prefix._roots.clear()
+        self.prefix.n_nodes = 0
+        return flushed
+
+    def set_params(self, params) -> None:
+        """Swap the weights future PREFILL INSERTS run under (decode /
+        verify launches take params from the engine) and flush the prefix
+        cache — its pages were built under the old weights and adopting
+        them after the swap would splice old-version K/V into new-version
+        streams. Reassignment alone never retraces (same tree shapes) and
+        params are never donated."""
+        self.params = params
+        self.flush_prefixes()
+
     # -- admission -------------------------------------------------------
     def fits(self, total_len: int) -> bool:
         """Could a request of ``total_len`` total positions (prompt +

@@ -99,6 +99,12 @@ class ServingMetrics:
     # invariant: spec_emitted == spec_accepted + spec_rows (each active
     # row commits its accepted run plus one correction per round).
     spec_k: int = 1
+    # weight rollover: the engine's current weights version (0 until the
+    # first swap stamps one) and how many hot swaps happened. Always in
+    # the snapshot — rollover must be observable even when the streaming
+    # subsystem is absent (a static engine reads version 0, swaps 0).
+    weights_version: int = 0
+    weight_swaps: int = 0
     spec_rounds: int = 0        # draft+verify program launches
     spec_drafted: int = 0       # drafter proposals scored
     spec_accepted: int = 0      # proposals matching the engine's rule
@@ -129,6 +135,13 @@ class ServingMetrics:
 
     def observe_submit(self) -> None:
         self.submitted += 1
+
+    def observe_swap(self, version: int) -> None:
+        """One hot weight swap; ``version`` is the version now serving
+        (NOT necessarily higher than the last one — a rollback republishes
+        an older version and the gauge must say so)."""
+        self.weight_swaps += 1
+        self.weights_version = int(version)
 
     def observe_prefill(self) -> None:
         self.prefills += 1
@@ -244,6 +257,8 @@ class ServingMetrics:
                 "batch_occupancy": round(self.batch_occupancy, 4),
                 "prefills": self.prefills,
                 "decode_steps": self.decode_steps,
+                "weights_version": self.weights_version,
+                "weight_swaps": self.weight_swaps,
             },
             "counters": {
                 "submitted": self.submitted,

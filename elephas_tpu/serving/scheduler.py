@@ -69,6 +69,12 @@ class ServingRequest:
     resume_prompt: Any = None      # prompt ++ generated after a preemption
     admit_seq: int = -1            # admission stamp (newest is preempted 1st)
     preemptions: int = 0
+    # weight-rollover attribution (engine-managed): the engine's
+    # weights_version when this request's prefill started, and one version
+    # stamp per emitted token (the version live at the decode round that
+    # emitted it — swap boundaries fall only between rounds)
+    prefill_version: int = 0
+    token_versions: List[int] = field(default_factory=list)
 
 
 class Scheduler:

@@ -694,6 +694,83 @@ class SparkModel:
         finally:
             self.stop_server()
 
+    # -- streaming train-to-serve ----------------------------------------
+    def fit_stream(self, batches, train_fn, *, sink=None,
+                   publish_every: int = 1,
+                   max_interval_s: Optional[float] = None,
+                   eval_fn=None, eval_batch=None,
+                   regression_margin: float = 0.0, ring_size: int = 4,
+                   checkpoint_dir: Optional[str] = None,
+                   checkpoint_every: int = 1) -> Dict[str, Any]:
+        """Streaming ingest with live weight publication (host PS path).
+
+        Drains ``batches`` (an iterable of micro-batches) through a
+        :class:`~elephas_tpu.streaming.trainer.StreamTrainer` against this
+        model's own parameter server — started/stopped exactly like
+        ``_fit_host_async``, standby replication and wrapper stack
+        included. ``train_fn(weights, batch) -> (new_weights, loss)`` runs
+        driver-side in PS wire order. With ``sink`` (e.g.
+        :func:`~elephas_tpu.streaming.publisher.engine_sink` over a live
+        serving engine) a :class:`WeightPublisher` publishes every
+        ``publish_every`` commits / ``max_interval_s`` seconds behind the
+        optional eval gate. With ``checkpoint_dir`` the stream runs under
+        a :class:`~elephas_tpu.resilience.supervisor.TrainingSupervisor`
+        (checkpoint every ``checkpoint_every`` commits, crash auto-resume
+        with exactly-once batch consumption).
+
+        Returns a JSON-able summary (commit count, publisher history);
+        the master network ends holding the final PS weights.
+        """
+        from .streaming import StreamTrainer, WeightPublisher
+
+        if self.mode not in ("asynchronous", "hogwild"):
+            raise ValueError(
+                "fit_stream needs a live parameter server "
+                f"(mode 'asynchronous' or 'hogwild', got {self.mode!r})")
+        if self.parameter_server_mode not in ("http", "socket", "native"):
+            raise ValueError(
+                "fit_stream runs against the host parameter servers "
+                f"(http/socket/native, got {self.parameter_server_mode!r})")
+        self.start_server()
+        try:
+            client = self._make_client()
+            try:
+                trainer = StreamTrainer(client, train_fn)
+                publisher = None
+                if sink is not None:
+                    publisher = WeightPublisher(
+                        client, sink, publish_every=publish_every,
+                        max_interval_s=max_interval_s, eval_fn=eval_fn,
+                        eval_batch=eval_batch,
+                        regression_margin=regression_margin,
+                        ring_size=ring_size,
+                    )
+                if checkpoint_dir is not None:
+                    from .resilience.supervisor import TrainingSupervisor
+
+                    supervisor = TrainingSupervisor(
+                        self, checkpoint_dir,
+                        checkpoint_frequency=checkpoint_every,
+                    )
+                    supervisor.fit_stream(batches, trainer,
+                                          publisher=publisher)
+                else:
+                    trainer.run(batches, publisher=publisher)
+                self._master_network.set_weights(client.get_parameters())
+                summary: Dict[str, Any] = {
+                    "commits": trainer.commits,
+                    "last_loss": trainer.last_loss,
+                    "last_version": int(
+                        getattr(client, "last_seen_version", -1)),
+                }
+                if publisher is not None:
+                    summary["publisher"] = publisher.state_dict()
+                return summary
+            finally:
+                client.close()
+        finally:
+            self.stop_server()
+
     # -- inference -------------------------------------------------------
     def predict(self, data, batch_size: Optional[int] = None):
         """Predict on a numpy array (reference: driver-local evaluation) or an

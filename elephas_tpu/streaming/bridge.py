@@ -1,0 +1,42 @@
+"""The params bridge: named model params <-> flat PS weight lists.
+
+The parameter server speaks ``List[np.ndarray]`` (pickle-friendly, no
+device round-trips); the LM/serving stack speaks ``Dict[str, array]``
+(:meth:`TransformerLM.init`). The bridge is a SORTED-KEY flatten — the
+order is a pure function of the key set, so any two processes that agree
+on the model config agree on the wire order without exchanging a schema.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+
+def params_to_list(params: Dict[str, Any]) -> List[np.ndarray]:
+    """Flatten a named-params dict to the PS wire order (sorted keys)."""
+    return [np.asarray(params[k]) for k in sorted(params)]
+
+
+def list_to_params(weights: List[Any],
+                   template: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    """Rebuild a named-params dict from PS wire order. ``template``
+    supplies the key set (values unused); shapes are checked leaf-by-leaf
+    so a mismatched model config fails loudly at the bridge, not as a
+    garbage forward pass."""
+    keys = sorted(template)
+    if len(keys) != len(weights):
+        raise ValueError(
+            f"weight list has {len(weights)} arrays but the params "
+            f"template has {len(keys)} keys")
+    out: Dict[str, np.ndarray] = {}
+    for key, w in zip(keys, weights):
+        w = np.asarray(w)
+        want = np.shape(template[key])
+        if tuple(w.shape) != tuple(want):
+            raise ValueError(
+                f"shape mismatch for {key!r}: wire {w.shape} vs "
+                f"template {tuple(want)} (model configs disagree?)")
+        out[key] = w
+    return out

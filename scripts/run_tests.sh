@@ -21,9 +21,10 @@
 # Environment (test env vars, e.g. JAX_PLATFORMS) must be set by the
 # caller; `make test` does that.
 #
-# Marker groups: ELEPHAS_TEST_GROUP=<marker> (e.g. `chaos`, or `perf` for
-# the slow train-step parity sweeps — see `make test-perf`) restricts every
-# shard to that pytest marker. The group's `-m` is appended AFTER the
+# Marker groups: ELEPHAS_TEST_GROUP=<marker> (e.g. `chaos`, `perf` for
+# the slow train-step parity sweeps, `spec`, or `streaming` for the
+# train-to-serve rollover pins — see the matching make targets) restricts
+# every shard to that pytest marker. The group's `-m` is appended AFTER the
 # caller's args because pytest honors only the LAST -m — so
 # `ELEPHAS_TEST_GROUP=chaos make test-fast` runs the chaos group even
 # though the Makefile target itself passes `-m "not slow"`.

@@ -57,6 +57,7 @@ def test_snapshot_is_json_able_and_complete():
     m.observe_reject("queue_full")
     m.observe_prefill()
     m.observe_decode_step(2)
+    m.observe_swap(3)
     m.observe_finish(_timing(rid="a", fin=5.0, gen=4))
     m.observe_finish(_timing(rid="b", sub=1.0, adm=1.5, first=3.5, fin=9.5,
                              gen=16))
@@ -65,7 +66,8 @@ def test_snapshot_is_json_able_and_complete():
 
     eng = roundtrip["engine"]
     assert eng == {"n_slots": 2, "active_slots": 1, "queue_depth": 3,
-                   "batch_occupancy": 1.0, "prefills": 1, "decode_steps": 1}
+                   "batch_occupancy": 1.0, "prefills": 1, "decode_steps": 1,
+                   "weights_version": 3, "weight_swaps": 1}
     ctr = roundtrip["counters"]
     assert ctr["submitted"] == 2
     assert ctr["rejected"] == {"queue_full": 1}

@@ -1,0 +1,292 @@
+"""The train-to-serve pipeline: StreamTrainer -> PS -> WeightPublisher.
+
+Runs against REAL parameter servers (http and socket, port=0) with a
+pure-numpy ``train_fn`` — the stream contract (ordered exactly-once
+commits, monotone version stamps), the publisher's cadence legs, the eval
+gate with poisoned-update auto-rollback, the bounded ring, and the
+``SparkModel.fit_stream`` entry point wiring it all to a live engine.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from elephas_tpu.parameter.client import BaseParameterClient
+from elephas_tpu.parameter.server import HttpServer, SocketServer
+from elephas_tpu.streaming import (
+    StreamTrainer,
+    WeightPublisher,
+    engine_sink,
+    list_to_params,
+    params_to_list,
+)
+
+pytestmark = pytest.mark.streaming
+
+SERVERS = {"http": HttpServer, "socket": SocketServer}
+
+
+def _weights():
+    return [np.zeros((3,), np.float32), np.ones((2, 2), np.float32)]
+
+
+def _server_client(kind):
+    server = SERVERS[kind](_weights(), port=0)
+    server.start()
+    client = BaseParameterClient.get_client(kind, port=server.port,
+                                            host="127.0.0.1", timeout=10.0)
+    return server, client
+
+
+def _train_fn(weights, batch):
+    """Deterministic toy step: add the batch scalar everywhere; loss is
+    the scalar (lets tests poison specific commits)."""
+    return [w + np.float32(batch) for w in weights], float(batch)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def advance(self, dt):
+        self.t += dt
+
+    def __call__(self):
+        return self.t
+
+
+# -- trainer --------------------------------------------------------------
+
+@pytest.mark.parametrize("kind", list(SERVERS))
+def test_trainer_commits_are_ordered_and_version_stamped(kind):
+    server, client = _server_client(kind)
+    try:
+        trainer = StreamTrainer(client, _train_fn)
+        commits = trainer.run([0.5, 1.0, 0.25, 2.0])
+        assert [c.index for c in commits] == [0, 1, 2, 3]
+        # one applied delta per commit: stamps are exactly 1..4
+        assert [c.version for c in commits] == [1, 2, 3, 4]
+        assert [c.loss for c in commits] == [0.5, 1.0, 0.25, 2.0]
+        # the PS master integrated every micro-batch exactly once
+        np.testing.assert_allclose(server.get_weights()[0],
+                                   np.full((3,), 3.75, np.float32))
+        assert trainer._tagged      # rode the exactly-once fence
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_trainer_resume_cursor_skips_committed_batches():
+    server, client = _server_client("socket")
+    try:
+        trainer = StreamTrainer(client, _train_fn)
+        trainer.run([1.0, 1.0, 1.0], publisher=None)
+        # resume from ordinal 3 of the same logical stream: 0..2 skipped
+        more = trainer.run([1.0, 1.0, 1.0, 1.0, 1.0], start_index=3)
+        assert [c.index for c in more] == [3, 4]
+        assert server.version == 5  # 3 + 2, nothing double-applied
+    finally:
+        client.close()
+        server.stop()
+
+
+# -- publisher cadence ----------------------------------------------------
+
+def test_publish_every_n_commits():
+    server, client = _server_client("http")
+    try:
+        seen = []
+        pub = WeightPublisher(client, lambda w, v: seen.append(v),
+                              publish_every=3)
+        StreamTrainer(client, _train_fn).run([1.0] * 7, publisher=pub)
+        assert seen == [3, 6]
+        assert pub.state_dict()["commits_since"] == 1
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_publish_time_leg_fires_between_count_boundaries():
+    server, client = _server_client("http")
+    try:
+        clock = FakeClock()
+        seen = []
+        pub = WeightPublisher(client, lambda w, v: seen.append(v),
+                              publish_every=100, max_interval_s=5.0,
+                              clock=clock)
+        trainer = StreamTrainer(client, _train_fn)
+        for i in range(4):
+            pub.offer(trainer.step(1.0, index=i))
+            clock.advance(2.0)
+        # the 4th offer (t=6s) crossed the 5s bound despite count << 100
+        assert seen == [4]
+    finally:
+        client.close()
+        server.stop()
+
+
+# -- eval gate + rollback -------------------------------------------------
+
+def _eval_fn(weights, batch):
+    # "loss" = mean weight magnitude: grows when a poisoned (huge) delta
+    # lands, shrinks/stays flat for the benign stream of negative batches
+    return float(np.mean([np.abs(w).mean() for w in weights]))
+
+
+def test_poisoned_update_auto_rolls_back():
+    """A poisoned commit regresses the eval gate: the sink is rolled back
+    to the last good version (original stamp), the candidate is refused,
+    and once training recovers the publisher resumes publishing."""
+    server, client = _server_client("socket")
+    try:
+        seen = []
+        pub = WeightPublisher(client, lambda w, v: seen.append((v, w[0][0])),
+                              publish_every=1, eval_fn=_eval_fn,
+                              regression_margin=1e-6)
+        trainer = StreamTrainer(client, _train_fn)
+        pub.offer(trainer.step(-0.25, index=0))     # good: publishes v1
+        pub.offer(trainer.step(100.0, index=1))     # poisoned: refused
+        pub.offer(trainer.step(-100.0, index=2))    # recovery: publishes v3
+        events = [r.event for r in pub.history]
+        assert events == ["publish", "rollback", "publish"]
+        rb = pub.history[1]
+        assert rb.version == 1 and rb.rejected_version == 2
+        # the poison NEVER reached the sink: it kept serving v1 (already
+        # the last good — no redundant republish), then took v3
+        assert [v for v, _ in seen] == [1, 3]
+        assert pub.rollbacks == 1 and pub.published == 2
+        assert pub.serving_version == 3
+
+        # a freshly restarted sink (resume: publisher state says v3 but
+        # the engine came back cold) DOES get last-good actively re-fed
+        # when the next candidate regresses
+        pub.serving_version = -1
+        pub.offer(trainer.step(100.0, index=3))     # poisoned again
+        assert [v for v, _ in seen] == [1, 3, 3]
+        np.testing.assert_allclose(seen[2][1], seen[1][1])
+        assert pub.rollbacks == 2 and pub.serving_version == 3
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_first_publish_has_no_gate_baseline():
+    server, client = _server_client("http")
+    try:
+        seen = []
+        pub = WeightPublisher(client, lambda w, v: seen.append(v),
+                              publish_every=1, eval_fn=_eval_fn)
+        pub.offer(StreamTrainer(client, _train_fn).step(50.0))
+        assert seen == [1]          # nothing to regress against yet
+        assert pub.last_good_loss is not None
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_ring_is_bounded_and_newest_wins():
+    server, client = _server_client("http")
+    try:
+        pub = WeightPublisher(client, lambda w, v: None, publish_every=1,
+                              ring_size=3)
+        trainer = StreamTrainer(client, _train_fn)
+        for i in range(6):
+            pub.offer(trainer.step(1.0, index=i))
+        assert pub.ring_versions() == [4, 5, 6]   # oldest fell off
+        # ring holds detached copies, not the live master
+        v, w, _ = pub.ring[-1]
+        trainer.step(99.0)
+        np.testing.assert_allclose(w[0], np.full((3,), 6.0, np.float32))
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_publisher_state_roundtrips_through_json():
+    import json
+
+    server, client = _server_client("http")
+    try:
+        pub = WeightPublisher(client, lambda w, v: None, publish_every=2,
+                              eval_fn=_eval_fn)
+        trainer = StreamTrainer(client, _train_fn)
+        for i in range(5):
+            pub.offer(trainer.step(-0.1, index=i))
+        state = json.loads(json.dumps(pub.state_dict()))  # JSON-able
+        clone = WeightPublisher(client, lambda w, v: None, publish_every=2,
+                                eval_fn=_eval_fn)
+        clone.load_state_dict(state, weights=server.get_weights())
+        assert clone.state_dict()["history"] == pub.state_dict()["history"]
+        assert clone.commits_since == pub.commits_since
+        assert clone.last_good_version == pub.last_good_version
+    finally:
+        client.close()
+        server.stop()
+
+
+# -- end-to-end: fit_stream wiring to a live engine ------------------------
+
+def test_fit_stream_publishes_into_live_engine():
+    """SparkModel.fit_stream drives its own PS + the publisher into a
+    live ServingEngine sink: the engine's version gauge advances, tokens
+    get attributed, and the master network ends on the final PS weights."""
+    from elephas_tpu import SparkModel
+    from elephas_tpu.models.transformer import TransformerLM
+    from elephas_tpu.serving import ServingEngine
+
+    model = TransformerLM(vocab=17, d_model=16, n_heads=4, n_layers=2,
+                          d_ff=32, max_len=48)
+    p0 = {k: jnp.asarray(v) for k, v in model.init(seed=1).items()}
+    eng = ServingEngine(model, p0, n_slots=2)
+    rng = np.random.default_rng(0)
+    rid = eng.submit(rng.integers(0, 17, size=(5,)).astype(np.int32), 4,
+                     seed=0)
+    eng.step()      # prefill + first token under the initial version 0
+
+    class _LMShim:
+        """Keras-shaped facade over the LM params for SparkModel's
+        start_server/set_weights plumbing (PS wire order = sorted keys)."""
+        def __init__(self, params):
+            self.params = dict(params)
+
+        def get_weights(self):
+            return params_to_list(self.params)
+
+        def set_weights(self, weights):
+            self.params = list_to_params(weights, self.params)
+
+    shim = _LMShim(model.init(seed=1))
+    sm = SparkModel(shim, mode="asynchronous",
+                    parameter_server_mode="socket", port=0)
+
+    def train_fn(weights, batch):
+        return [w + np.float32(batch) * 1e-3 for w in weights], float(batch)
+
+    def sink(weights, version):
+        engine_sink(eng, p0)(weights, version)
+        eng.step()          # decode a round under each published version
+
+    summary = sm.fit_stream([1.0, 2.0, 3.0, 4.0], train_fn, sink=sink,
+                            publish_every=2)
+    eng.drain(max_steps=200)
+    assert summary["commits"] == 4
+    assert summary["publisher"]["published"] == 2
+    assert summary["last_version"] == 4
+    assert eng.weights_version == 4              # last published stamp
+    rec = eng.result(rid)
+    assert rec.version_first == 0 and rec.version_last == 4
+    assert all(v in (0, 2, 4) for v in rec.token_versions)
+    # the master network integrated all four micro-batches
+    np.testing.assert_allclose(
+        shim.params["tok"],
+        np.asarray(model.init(seed=1)["tok"]) + np.float32(10.0) * 1e-3,
+        rtol=1e-5, atol=1e-6)
+
+
+def test_fit_stream_rejects_modes_without_live_ps(classifier_factory):
+    from elephas_tpu import SparkModel
+
+    sm = SparkModel(classifier_factory(), mode="synchronous")
+    with pytest.raises(ValueError, match="fit_stream"):
+        sm.fit_stream([1.0], _train_fn)

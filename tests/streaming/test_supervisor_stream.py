@@ -1,0 +1,156 @@
+"""Streaming under the TrainingSupervisor: crash mid-stream, resume from
+the checkpointed cursor + publisher state, and replay the version history
+DETERMINISTICALLY — the crashed-and-resumed run produces the identical
+commit stream, publish/rollback history, and final weights as an
+uninterrupted run at the same seed."""
+
+import numpy as np
+import pytest
+
+from elephas_tpu.parameter.client import BaseParameterClient
+from elephas_tpu.parameter.server import SocketServer
+from elephas_tpu.resilience import SupervisorAborted, TrainingSupervisor
+from elephas_tpu.streaming import StreamTrainer, WeightPublisher
+from elephas_tpu.utils.checkpoint import load_checkpoint
+
+pytestmark = pytest.mark.streaming
+
+
+def _weights():
+    return [np.zeros((3,), np.float32)]
+
+
+def _batches(seed, n=8):
+    rng = np.random.default_rng(seed)
+    return [float(x) for x in rng.normal(size=n)]
+
+
+def _train_fn(weights, batch):
+    return [w + np.float32(batch) for w in weights], float(batch)
+
+
+class CrashingTrainFn:
+    """Deterministic train step that dies ONCE at batch ordinal
+    ``crash_at`` (batch boundaries are the only crash sites the stream
+    contract needs to survive: a mid-push crash is the PS attempt
+    machinery's job, pinned in the chaos suite)."""
+
+    def __init__(self, crash_at):
+        self.crash_at = crash_at
+        self.calls = 0
+
+    def __call__(self, weights, batch):
+        self.calls += 1
+        if self.calls == self.crash_at:
+            self.crash_at = None        # crash once
+            raise RuntimeError("injected stream crash")
+        return _train_fn(weights, batch)
+
+
+def _run_stream(tmpdir, batches, train_fn, *, crash=False,
+                publish_every=2, eval_gate=True):
+    """One full supervised stream against a fresh socket PS; returns
+    (publish history, final PS weights, supervisor events)."""
+    server = SocketServer(_weights(), port=0)
+    server.start()
+    client = BaseParameterClient.get_client("socket", port=server.port,
+                                            host="127.0.0.1", timeout=10.0)
+    try:
+        published = []
+        eval_fn = ((lambda w, b: float(np.abs(w[0]).mean()))
+                   if eval_gate else None)
+        pub = WeightPublisher(client,
+                              lambda w, v: published.append((v, w[0][0])),
+                              publish_every=publish_every, eval_fn=eval_fn,
+                              regression_margin=0.5)
+        trainer = StreamTrainer(client, train_fn)
+        sup = TrainingSupervisor(None, str(tmpdir),
+                                 checkpoint_frequency=1,
+                                 max_restarts=2 if crash else 0)
+        sup.fit_stream(batches, trainer, publisher=pub)
+        history = [dict(e.__dict__) for e in pub.history]
+        return history, [w.copy() for w in server.get_weights()], sup.events
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_crash_resume_replays_version_history_exactly(tmp_path):
+    """The pinned determinism scenario: same seed, crash at batch 5 vs no
+    crash — identical publish/rollback history (versions, losses, commit
+    indices), identical final weights, and the server never applied a
+    batch twice."""
+    batches = _batches(seed=42)
+
+    clean_hist, clean_w, clean_events = _run_stream(
+        tmp_path / "clean", batches, _train_fn)
+
+    crashed_hist, crashed_w, events = _run_stream(
+        tmp_path / "crashed", batches, CrashingTrainFn(crash_at=5),
+        crash=True)
+
+    assert [e.kind for e in events] == ["start", "crash", "resume",
+                                        "complete"]
+    assert crashed_hist == clean_hist       # version history replays
+    np.testing.assert_allclose(crashed_w[0], clean_w[0], rtol=1e-6)
+    # exactly-once: final version == number of batches, both runs
+    assert clean_hist[-1]["version"] <= len(batches)
+
+
+def test_checkpoint_carries_cursor_and_publisher_state(tmp_path):
+    batches = _batches(seed=7, n=5)
+    _run_stream(tmp_path, batches, _train_fn, publish_every=2)
+    weights, meta, _ = load_checkpoint(str(tmp_path))
+    assert meta["mode"] == "stream"
+    stream = meta["stream"]
+    assert stream["batches_done"] == 5
+    assert stream["commits"] == 5
+    pub_state = stream["publisher"]
+    assert pub_state["published"] >= 1
+    assert [r["event"] for r in pub_state["history"]]
+    # checkpointed weights are the PS master at the cursor
+    np.testing.assert_allclose(
+        weights[0], np.full((3,), sum(batches), np.float32), rtol=1e-5)
+
+
+def test_restart_budget_still_enforced_for_streams(tmp_path):
+    server = SocketServer(_weights(), port=0)
+    server.start()
+    client = BaseParameterClient.get_client("socket", port=server.port,
+                                            host="127.0.0.1", timeout=10.0)
+    try:
+        class AlwaysCrash:
+            def __call__(self, weights, batch):
+                raise RuntimeError("always dies")
+
+        trainer = StreamTrainer(client, AlwaysCrash())
+        sup = TrainingSupervisor(None, str(tmp_path / "cp"),
+                                 checkpoint_frequency=1, max_restarts=1)
+        with pytest.raises(SupervisorAborted, match="budget"):
+            sup.fit_stream(_batches(seed=1, n=3), trainer)
+        assert sup.restarts == 1
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_resume_skips_committed_batches_on_live_server(tmp_path):
+    """The PS outlives the driver crash: resume must NOT re-apply
+    committed batches to the still-warm server (the version counter would
+    jump and the weights would double-integrate)."""
+    server = SocketServer(_weights(), port=0)
+    server.start()
+    client = BaseParameterClient.get_client("socket", port=server.port,
+                                            host="127.0.0.1", timeout=10.0)
+    try:
+        batches = [1.0, 1.0, 1.0, 1.0]
+        trainer = StreamTrainer(client, CrashingTrainFn(crash_at=3))
+        sup = TrainingSupervisor(None, str(tmp_path),
+                                 checkpoint_frequency=1, max_restarts=1)
+        sup.fit_stream(batches, trainer)
+        assert server.version == 4      # one applied delta per batch
+        np.testing.assert_allclose(server.get_weights()[0],
+                                   np.full((3,), 4.0, np.float32))
+    finally:
+        client.close()
+        server.stop()

@@ -1,0 +1,227 @@
+"""Version piggyback parity across transports + monotonicity on failover.
+
+The HTTP transport has stamped pulls with ``X-Elephas-Version`` since the
+failover PR; the socket transport only had the explicit ``b"v"`` probe —
+so a socket pull could not bound its own staleness. The ``b"G"`` opcode
+closes that gap: one atomic ``(version, weights)`` pair per pull, with a
+probe-and-degrade dance against legacy servers (which close the
+connection on the unknown opcode).
+
+The capstone is the replication-lag scenario: a client that committed
+through the primary must NEVER observe a post-failover pull older than
+its last acknowledged commit — the FailoverClient holds the pull until
+the standby's version catches up, which only works because pulls now
+carry versions on BOTH transports.
+"""
+
+import socket as socket_mod
+import threading
+
+import numpy as np
+import pytest
+
+from elephas_tpu.parameter.client import BaseParameterClient, SocketClient
+from elephas_tpu.parameter.server import HttpServer, SocketServer
+from elephas_tpu.resilience.policy import FailoverClient
+from elephas_tpu.utils import sockets as socket_utils
+
+pytestmark = pytest.mark.streaming
+
+
+def _weights():
+    return [np.zeros((3,), np.float32)]
+
+
+def _delta(v):
+    return [np.full((3,), v, np.float32)]
+
+
+# -- cross-transport parity -----------------------------------------------
+
+def test_pull_version_piggyback_parity_http_vs_socket():
+    """Same update sequence, both transports: every pull leaves the
+    client holding the exact server version those weights correspond to,
+    and the weights agree bit-for-bit."""
+    servers = {k: cls(_weights(), port=0)
+               for k, cls in (("http", HttpServer), ("socket", SocketServer))}
+    clients = {}
+    try:
+        for kind, server in servers.items():
+            server.start()
+            clients[kind] = BaseParameterClient.get_client(
+                kind, port=server.port, host="127.0.0.1", timeout=10.0)
+        for step in range(1, 4):
+            pulled = {}
+            for kind in servers:
+                servers[kind].apply_delta(_delta(1.0))
+                pulled[kind] = clients[kind].get_parameters()
+                assert clients[kind].last_seen_version == step, kind
+            np.testing.assert_array_equal(pulled["http"][0],
+                                          pulled["socket"][0])
+    finally:
+        for c in clients.values():
+            c.close()
+        for s in servers.values():
+            s.stop()
+
+
+def test_versioned_weights_pair_is_consistent():
+    server = SocketServer(_weights(), port=0)
+    server.start()
+    try:
+        for i in range(3):
+            server.apply_delta(_delta(1.0))
+            version, weights = server.get_versioned_weights()
+            assert version == i + 1
+            np.testing.assert_allclose(
+                weights[0], np.full((3,), -(i + 1.0), np.float32))
+    finally:
+        server.stop()
+
+
+# -- legacy degrade -------------------------------------------------------
+
+class _LegacyServer:
+    """A pre-versioned-pull socket server: knows ``b"g"``/``b"v"`` only
+    and CLOSES the connection on any other opcode (the real legacy
+    listener's ``else: break``)."""
+
+    def __init__(self, weights):
+        self.weights = weights
+        self.version = 0
+        self._sock = socket_mod.socket()
+        self._sock.setsockopt(socket_mod.SOL_SOCKET,
+                              socket_mod.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(4)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        self._sock.settimeout(0.2)
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket_mod.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                while True:
+                    op = conn.recv(1)
+                    if op == b"g":
+                        socket_utils.send(conn, self.weights)
+                    elif op == b"v":
+                        socket_utils.send(conn, self.version)
+                    else:
+                        break
+            except OSError:
+                pass
+            finally:
+                conn.close()
+
+    def stop(self):
+        self._stop.set()
+        self._sock.close()
+        self._thread.join(timeout=5)
+
+
+def test_socket_client_degrades_against_legacy_server():
+    """First pull probes ``b"G"``, eats the legacy close, retries with
+    ``b"g"`` on a fresh connection, and stays degraded — later pulls go
+    straight to the legacy opcode. No version piggyback, exactly like a
+    pre-header HTTP server."""
+    legacy = _LegacyServer(_weights())
+    client = SocketClient(port=legacy.port, host="127.0.0.1", timeout=10.0)
+    try:
+        assert client._versioned_pull
+        for _ in range(2):
+            weights = client.get_parameters()
+            np.testing.assert_array_equal(weights[0], _weights()[0])
+        assert not client._versioned_pull
+        assert client.last_seen_version == -1    # staleness unbounded
+    finally:
+        client.close()
+        legacy.stop()
+
+
+def test_socket_client_restores_probe_after_outage():
+    """A DEAD server also fails the ``b"G"`` probe — but the legacy
+    fallback fails too, which distinguishes outage from old code: the
+    probe is restored so a recovered modern server isn't permanently
+    downgraded."""
+    server = SocketServer(_weights(), port=0)
+    server.start()
+    port = server.port
+    server.stop()
+    client = SocketClient(port=port, host="127.0.0.1", timeout=2.0)
+    with pytest.raises((ConnectionError, OSError)):
+        client.get_parameters()
+    assert client._versioned_pull       # outage != legacy
+
+    revived = SocketServer(_weights(), port=port)
+    revived.start()
+    try:
+        revived.apply_delta(_delta(1.0))
+        client.get_parameters()
+        assert client.last_seen_version == 1   # piggyback back in force
+    finally:
+        client.close()
+        revived.stop()
+
+
+# -- monotonicity under replication lag -----------------------------------
+
+class GatedStandby(SocketServer):
+    """Standby whose replicated applies block on a gate: deterministic
+    replication LAG, released mid-test."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.gate = threading.Event()
+
+    def apply_delta(self, delta, task_id=None, attempt=None):
+        assert self.gate.wait(timeout=30), "test gate never released"
+        super().apply_delta(delta, task_id=task_id, attempt=attempt)
+
+
+def test_post_failover_pull_never_older_than_acknowledged_commits():
+    """The pinned scenario: 3 commits acknowledged through the primary
+    (version 3 observed), standby stuck at 0 behind a replication gate,
+    primary dies. The next pull MUST NOT serve version-0 weights — the
+    failover holds it until the standby drains to >= 3."""
+    primary = SocketServer(_weights(), port=0, name="primary")
+    standby = GatedStandby(_weights(), port=0, name="standby")
+    primary.start()
+    standby.start()
+    primary.attach_standby(standby)
+    cp = SocketClient(port=primary.port, host="127.0.0.1", timeout=5.0)
+    cs = SocketClient(port=standby.port, host="127.0.0.1", timeout=5.0)
+    client = FailoverClient([cp, cs], staleness_wait_s=10.0, poll_s=0.01)
+    try:
+        for _ in range(3):
+            client.update_parameters(_delta(1.0))
+        assert client.get_version() == 3     # commits acknowledged
+        assert standby.version == 0          # replication is gated
+
+        primary._dead = True                 # fail-stop: new traffic dies
+        # release the lag only AFTER the failed-over pull is already
+        # waiting on the standby's catch-up poll
+        threading.Timer(0.3, standby.gate.set).start()
+        weights = client.get_parameters()
+
+        assert client.failovers == 1
+        # the pull reflects every acknowledged commit — not the stale
+        # version-0 standby state the gate was holding
+        np.testing.assert_allclose(weights[0],
+                                   np.full((3,), -3.0, np.float32))
+        assert cs.last_seen_version >= 3     # socket pull carried the stamp
+        assert standby.version >= 3
+    finally:
+        standby.gate.set()
+        cp.close()
+        cs.close()
+        primary.stop()
+        standby.stop()
