@@ -8,7 +8,8 @@ TEST_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 KERAS_BACKEND=jax
 
 .PHONY: test test-fast test-chaos test-perf test-spec test-streaming \
-	bench bench-serving bench-paged bench-lm bench-spec
+	test-fleet bench bench-serving bench-paged bench-lm bench-spec \
+	bench-fleet
 
 test:
 	$(TEST_ENV) bash scripts/run_tests.sh -x -q
@@ -37,6 +38,12 @@ test-spec:
 test-streaming:
 	ELEPHAS_TEST_GROUP=streaming $(TEST_ENV) bash scripts/run_tests.sh -x -q
 
+# Serving-fleet pins only (trace determinism, DRR fairness, router
+# migration identity, autoscaler scale-up/down, the pinned fleet chaos
+# scenario with kill + join mid-trace).
+test-fleet:
+	ELEPHAS_TEST_GROUP=fleet $(TEST_ENV) bash scripts/run_tests.sh -x -q
+
 bench:
 	KERAS_BACKEND=jax python bench.py
 
@@ -61,6 +68,14 @@ bench-spec:
 bench-paged:
 	KERAS_BACKEND=jax python -c "import json, bench; \
 	print(json.dumps({'paged_kv': bench.bench_paged_kv(3)}))"
+
+# Fleet bench only: SLO attainment vs offered load at 2 and 4 partitions
+# on the pinned deterministic trace, plus the autoscaler miss-rate
+# recovery scenario. JAX_PLATFORMS=cpu: the judged numbers are scheduling
+# quality on the SimClock, not accelerator throughput.
+bench-fleet:
+	JAX_PLATFORMS=cpu KERAS_BACKEND=jax python -c "import json, bench; \
+	print(json.dumps({'fleet': bench.bench_fleet(3)}))"
 
 # LM section only, forced on (BENCH_LM=1 runs it even off-TPU): the judged
 # geometry with per-phase timing (fwd_ms / bwd_reduce_ms / apply_ms /

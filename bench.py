@@ -1245,6 +1245,168 @@ def bench_streaming(reps: int):
     return out
 
 
+def bench_fleet(reps: int):
+    """SLO attainment vs offered load across fleet sizes, plus the
+    autoscaler recovery scenario.
+
+    CPU-runnable and fully deterministic: the fleet replays a pinned
+    bursty multi-tenant trace (every request carries a deadline) on a
+    ``SimClock`` shared by engines, router, registry, and autoscaler, so
+    attainment/latency numbers are pure functions of (trace, fleet
+    config) — wall-clock only measures replay cost. Three judged
+    questions:
+
+    1. attainment vs offered load at >=2 fleet sizes: the same trace is
+       offered at 1x and 2x arrival density against 2- and 4-partition
+       fleets — attainment must be monotone in fleet size at fixed load;
+    2. p50/p99 TTFT and inter-token latency (sim-seconds) per cell;
+    3. recovery: a 1-partition fleet under the 2x trace with a
+       miss-rate-triggered autoscaler — the deadline-miss rate among
+       requests ARRIVING after the first scale-up must drop vs the
+       rate among those that arrived into the undersized fleet
+       (grouping by arrival, not completion, keeps the overload
+       backlog's late finishes out of the "after" bucket).
+
+    Skip with BENCH_FLEET=0; knobs via BENCH_FLEET_{RPS,DURATION,
+    TENANTS,SLOTS,STEPDT} (trace shape) on top of the shared
+    BENCH_SERVE_FAST_{DMODEL,LAYERS,VOCAB} geometry.
+    """
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    if os.environ.get("BENCH_FLEET", "1") == "0":
+        log("fleet bench: skipped (BENCH_FLEET=0)")
+        return None
+
+    from elephas_tpu.fleet import (Autoscaler, FleetPolicy, FleetRouter,
+                                   SimClock, TrafficModel, run_trace)
+    from elephas_tpu.models import TransformerLM
+    from elephas_tpu.serving import ServingEngine
+
+    def knob(name, default, cast=int):
+        return cast(os.environ.get(f"BENCH_FLEET_{name.upper()}", default))
+
+    def geo(name, default):
+        return int(os.environ.get(f"BENCH_SERVE_{name.upper()}", default))
+
+    d_model = geo("fast_dmodel", 64)
+    n_layers = geo("fast_layers", 2)
+    n_heads = max(1, d_model // 64)
+    vocab = geo("fast_vocab", 512)
+    base_rps = knob("rps", 5.0, float)
+    duration_s = knob("duration", 12.0, float)
+    n_tenants = knob("tenants", 4)
+    n_slots = knob("slots", 4)
+    step_dt = knob("stepdt", 0.05, float)
+
+    model = TransformerLM(
+        vocab=vocab, d_model=d_model, n_heads=n_heads, n_layers=n_layers,
+        d_ff=4 * d_model, max_len=64, pos_encoding="rotary",
+        tie_embeddings=True,
+    )
+    params = {k: jnp.asarray(v) for k, v in model.init(seed=0).items()}
+    trace = TrafficModel(
+        seed=0, base_rps=base_rps, duration_s=duration_s,
+        n_tenants=n_tenants, vocab=vocab, prompt_len_median=8.0,
+        prompt_len_max=24, max_new_median=6.0, max_new_max=12,
+        deadline_base_s=1.5, deadline_per_token_s=0.05,
+        batch_deadline_s=2.5,       # EVERY request carries a deadline
+    ).generate()
+    log(f"fleet: trace {len(trace)} reqs / {trace.offered_rps:.1f} rps, "
+        f"{n_tenants} tenants (compiling...)")
+
+    def run_cell(n_parts, load, autoscale=False):
+        clock = SimClock()
+
+        def factory(pid):
+            return ServingEngine(model, params, n_slots=n_slots,
+                                 max_queue=32, clock=clock,
+                                 perf_clock=clock)
+
+        # itl floor = one token per fleet step: provably-hopeless backlog
+        # sheds immediately instead of poisoning the queue until expiry
+        router = FleetRouter(factory, n_parts,
+                             policy=FleetPolicy(itl_estimate_s=step_dt),
+                             clock=clock, lease_s=2.0)
+        scaler = None
+        if autoscale:
+            scaler = Autoscaler(router, min_partitions=n_parts,
+                                max_partitions=8, cooldown_s=0.5,
+                                queue_high=1e9, miss_rate_high=0.02)
+        t0 = time.perf_counter()
+        snap = run_trace(router, trace.scaled(load), clock=clock,
+                         step_dt=step_dt, autoscaler=scaler)
+        wall = time.perf_counter() - t0
+        return router, scaler, snap, wall
+
+    run_cell(2, 1.0)                    # warmup/compile
+    rows = []
+    loads = (2.0, 4.0)                  # 2x ~ fleet capacity, 4x past it
+    for n_parts in (2, 4):
+        for load in loads:
+            reps_here = max(1, reps) if (n_parts, load) == (4, loads[-1]) else 1
+            best_wall = float("inf")
+            for _ in range(reps_here):
+                _, _, snap, wall = run_cell(n_parts, load)
+                best_wall = min(best_wall, wall)
+            slo, lat = snap["slo"], snap["latency"]
+            rows.append({
+                "partitions": n_parts,
+                "load_x": load,
+                "offered_rps": round(slo["offered_rps"], 2),
+                "attainment": round(slo["attainment"], 4),
+                "deadline_missed": slo["deadline_missed"],
+                "ttft_p50_s": round(lat["ttft_p50"], 3),
+                "ttft_p99_s": round(lat["ttft_p99"], 3),
+                "itl_p50_s": round(lat["itl_p50"], 3),
+                "itl_p99_s": round(lat["itl_p99"], 3),
+                "migrations": snap["fleet"]["migrations"],
+                "replay_wall_s": round(best_wall, 2),
+            })
+            log(f"fleet {n_parts}p @ {load}x: attainment "
+                f"{rows[-1]['attainment']:.3f}, ttft p99 "
+                f"{rows[-1]['ttft_p99_s']}s, itl p99 "
+                f"{rows[-1]['itl_p99_s']}s ({best_wall:.1f}s wall)")
+
+    # -- autoscaler recovery: misses trigger growth, growth ends misses --
+    router, scaler, snap, _ = run_cell(1, loads[0], autoscale=True)
+    ups = [e for e in scaler.events if e["action"] == "up"]
+    recovery = None
+    if ups:
+        t_up = ups[0]["t"]
+        before = after = miss_b = miss_a = 0
+        for st in router.results().values():
+            if st.deadline_at is None or st.finished_at is None:
+                continue
+            missed = (st.finish_reason not in ("eos", "length")
+                      or st.finished_at > st.deadline_at)
+            if st.req.arrival_s <= t_up:
+                before += 1
+                miss_b += missed
+            else:
+                after += 1
+                miss_a += missed
+        recovery = {
+            "first_scale_up_t": t_up,
+            "scale_ups": len(ups),
+            "partitions_final": router.n_live,
+            "miss_rate_before": round(miss_b / before, 4) if before else None,
+            "miss_rate_after": round(miss_a / after, 4) if after else None,
+        }
+        log(f"fleet autoscaler: {len(ups)} scale-ups, miss rate "
+            f"{recovery['miss_rate_before']} -> "
+            f"{recovery['miss_rate_after']}")
+
+    return {
+        "trace_requests": len(trace),
+        "sweep": rows,
+        "autoscaler": recovery,
+        "config": (f"d{d_model}xL{n_layers}xH{n_heads}-V{vocab}"
+                   f"-s{n_slots}-rps{base_rps}x{duration_s}s"),
+    }
+
+
 def make_model(input_dim, nb_classes):
     import keras
 
@@ -1450,6 +1612,16 @@ def main():
         streaming = None
     if streaming is not None:
         result["streaming"] = streaming
+        print(json.dumps(result), flush=True)
+
+    # -- fleet phase: SLO attainment vs offered load (CPU-runnable) -------
+    try:
+        fleet = bench_fleet(reps)
+    except Exception as e:
+        log(f"fleet bench failed: {type(e).__name__}: {e}")
+        fleet = None
+    if fleet is not None:
+        result["fleet"] = fleet
         print(json.dumps(result), flush=True)
 
     # -- LM phase: FLOPs-accounted tokens/sec + MFU on the same chip ------

@@ -76,9 +76,12 @@ including the chunked insert and the fused decode.
 
 Time is injectable (``clock=``): latency tests pin exact TTFT/queue-wait
 numbers with a fake clock instead of sleeping. The fast-path histograms
-(inter-token latency, dispatch overhead, chunk stalls) deliberately use
-``time.perf_counter`` instead — they measure wall clock, and reading the
-injectable clock for them would perturb fake-clock tests.
+(inter-token latency, dispatch overhead, chunk stalls) deliberately read
+a SEPARATE ``perf_clock`` (``time.perf_counter`` by default) — they
+measure wall clock, and reading the lifecycle clock for them would
+perturb fake-clock tests. The fleet trace-replay harness injects a
+simulated ``perf_clock`` so even the latency histograms replay
+deterministically in tier-1; the real-time default is unchanged.
 """
 
 from __future__ import annotations
@@ -286,7 +289,9 @@ class FinishedRequest:
     request_id: str
     prompt: np.ndarray            # [T0] int32
     tokens: List[int]             # generated continuation (EOS included)
-    finish_reason: str            # "eos" | "length" | "deadline" | "cancelled"
+    # "eos" | "length" | "deadline" | "cancelled" | "shed" (deadline
+    # provably unmeetable at admission time — never cost a slot)
+    finish_reason: str
     timing: RequestTiming
     token_versions: List[int] = field(default_factory=list)
     version_first: int = -1
@@ -307,7 +312,9 @@ class ServingEngine:
                  fuse_k: int = 1, paged: bool = False, page_size: int = 16,
                  pages_per_partition: Optional[int] = None,
                  prefix_cache: bool = True, speculate_k: int = 1,
-                 drafter=None):
+                 drafter=None,
+                 perf_clock: Callable[[], float] = time.perf_counter,
+                 itl_estimate_s: Optional[float] = None):
         if max_finished < 1:
             raise ValueError(f"max_finished must be >= 1, got {max_finished}")
         if fuse_k < 1:
@@ -326,9 +333,23 @@ class ServingEngine:
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError(
                 f"prefill_chunk must be >= 1, got {prefill_chunk}")
+        if itl_estimate_s is not None and itl_estimate_s <= 0:
+            raise ValueError(
+                f"itl_estimate_s must be > 0, got {itl_estimate_s}")
         self.model = model
         self.params = params
         self.clock = clock
+        # latency-histogram clock (ITL / dispatch / chunk stalls): real
+        # wall time by default, injectable so fleet trace replay pins the
+        # histograms deterministically. Separate from ``clock`` so fake
+        # lifecycle clocks never see extra reads.
+        self._perf = perf_clock
+        # per-token latency floor for deadline-aware admission: a queued
+        # request whose remaining budget cannot finish by its deadline
+        # even at this rate is SHED at decide time instead of admitted and
+        # reaped late. None = only already-expired queued work is shed.
+        self.itl_estimate_s = (None if itl_estimate_s is None
+                               else float(itl_estimate_s))
         self.max_finished = int(max_finished)
         # chunk size rounds UP to the insert kernel's bucket grid so a
         # full chunk is never padded (one compiled program per chunk)
@@ -512,7 +533,7 @@ class ServingEngine:
             raise
         self._next_id += 1
         self._requests[rid] = req
-        self.metrics.observe_submit()
+        self.metrics.observe_submit(req.adapter_id)
         return rid
 
     # -- the loop --------------------------------------------------------
@@ -527,6 +548,7 @@ class ServingEngine:
         if self.fault_plan is not None:
             self._skew += self.fault_plan.serving_stall(self._step_index)
         self._step_index += 1
+        self._shed_unmeetable()
         self._reap_expired()
         # live decode rows only: a partially-prefilled slot is allocated
         # but must not count as decodable (with no live rows its chunks
@@ -662,10 +684,28 @@ class ServingEngine:
         self._finish_early(req, "cancelled")
         return True
 
+    def _shed_unmeetable(self) -> None:
+        """Shed QUEUED requests that provably cannot meet their deadline
+        (:meth:`Scheduler.unmeetable`): already expired, or — when the
+        engine has an ``itl_estimate_s`` latency floor — the remaining
+        budget overruns the deadline even at that floor. Distinct
+        ``"shed"`` finish reason: the request was dropped before it cost
+        a slot, which is different from a ``"deadline"`` reap of admitted
+        work and lets callers retry against another replica."""
+        for req in self.scheduler.unmeetable(self._now(),
+                                             self.itl_estimate_s):
+            self._finish_early(req, "shed")
+
     def _reap_expired(self) -> None:
+        """Reap ADMITTED requests whose deadline passed ("deadline" —
+        they cost a slot and may carry partial tokens). Queued requests
+        are :meth:`_shed_unmeetable`'s job: an expired deadline is the
+        degenerate unmeetable case, and the distinct "shed" reason
+        records that the request never cost a slot."""
         now = self._now()
         for req in list(self._requests.values()):
-            if req.deadline_at is not None and now >= req.deadline_at:
+            if (req.slot is not None and req.deadline_at is not None
+                    and now >= req.deadline_at):
                 self._finish_early(req, "deadline")
 
     def _finish_early(self, req: ServingRequest, reason: str) -> None:
@@ -688,7 +728,8 @@ class ServingEngine:
         req.timing.finished_at = self._now()
         req.timing.generated_tokens = len(req.generated)
         req.timing.finish_reason = reason
-        self.metrics.observe_cancel(reason)
+        self.metrics.observe_cancel(reason, adapter_id=req.adapter_id,
+                                    tokens=len(req.generated))
         self._file_finished(self._terminal_record(req, reason))
 
     def drain(self, max_steps: Optional[int] = None
@@ -769,7 +810,7 @@ class ServingEngine:
         req.timing.admitted_at = self._now()
         req.slot = slot
         req.prefill_version = self.weights_version
-        self.metrics.observe_prefill()
+        self.metrics.observe_prefill(req.adapter_id)
         prompt = self._req_prompt(req)
         T0 = int(prompt.shape[0])
         if self._paged:
@@ -796,11 +837,11 @@ class ServingEngine:
         T0 = int(prompt.shape[0])
         start = req.prefill_pos
         end = min(start + self.prefill_chunk, T0)
-        t0 = time.perf_counter()
+        t0 = self._perf()
         last = self._insert_guarded(req, prompt[start:end], pos0=start)
         last.block_until_ready()
         self.metrics.observe_prefill_chunk(
-            end - start, len(self._slot_req), time.perf_counter() - t0)
+            end - start, len(self._slot_req), self._perf() - t0)
         req.prefill_pos = end
         if end < T0:
             # park the row non-live AT THE WRITE HEAD: the garbage K/V an
@@ -1021,12 +1062,12 @@ class ServingEngine:
             if not self._slot_req:
                 return
         n_active = len(self._slot_req)
-        t0 = time.perf_counter()
+        t0 = self._perf()
         drafts = self._draft_tokens(W)
         sel, n_acc, self._tok, self._pos, self.kv.cache = self._verify_fn(
             self.params, self.kv.cache, drafts, self._tok, self._pos,
             self._temps, self._keys, self._live)
-        t1 = time.perf_counter()
+        t1 = self._perf()
         toks = np.asarray(sel)
         n_acc = np.asarray(n_acc)
         act = list(self._slot_req.items())
@@ -1042,7 +1083,7 @@ class ServingEngine:
         self.metrics.observe_spec_round(
             n_active, n_drafted=n_active * W, n_accepted=accepted,
             n_emitted=accepted + n_active, block_s=t1 - t0,
-            host_s=time.perf_counter() - t1)
+            host_s=self._perf() - t1)
 
     def _do_decode(self) -> None:
         W = self._spec_window()
@@ -1058,7 +1099,7 @@ class ServingEngine:
             if not self._slot_req:
                 return
         n_active = len(self._slot_req)
-        t0 = time.perf_counter()
+        t0 = self._perf()
         if K == 1:
             emit, self._tok, self._pos, self.kv.cache = self._decode_fn(
                 self.params, self.kv.cache, self._tok, self._pos,
@@ -1069,7 +1110,7 @@ class ServingEngine:
                 self.params, self.kv.cache, self._tok, self._pos,
                 self._temps, self._keys, self._live, n_steps=K)
             toks = np.asarray(emit)             # [S, K]
-        t1 = time.perf_counter()
+        t1 = self._perf()
         for slot, req in list(self._slot_req.items()):
             # consume this row's emitted tokens in order; stop at its
             # finish (EOS/budget/cancel-from-callback) — the device kept
@@ -1084,7 +1125,7 @@ class ServingEngine:
                 self._emit(req, int(toks[slot, j]))
         self.metrics.observe_decode_block(
             n_active, K, block_s=t1 - t0,
-            host_s=time.perf_counter() - t1)
+            host_s=self._perf() - t1)
 
     def _emit(self, req: ServingRequest, tok: int) -> None:
         """Deliver one generated token: record, stream, finish/continue.
@@ -1103,7 +1144,7 @@ class ServingEngine:
         req.timing.finished_at = self._now()
         req.timing.generated_tokens = len(req.generated)
         req.timing.finish_reason = "eos" if done_eos else "length"
-        self.metrics.observe_finish(req.timing)
+        self.metrics.observe_finish(req.timing, adapter_id=req.adapter_id)
         self._file_finished(
             self._terminal_record(req, req.timing.finish_reason))
         slot = req.slot

@@ -35,7 +35,8 @@ class RequestTiming:
     first_token_at: Optional[float] = None   # first generated token emitted
     finished_at: Optional[float] = None
     generated_tokens: int = 0
-    finish_reason: Optional[str] = None      # "eos"|"length"|"deadline"|"cancelled"
+    # "eos"|"length"|"deadline"|"cancelled"|"shed"
+    finish_reason: Optional[str] = None
 
     @property
     def queue_wait(self) -> Optional[float]:
@@ -113,28 +114,48 @@ class ServingMetrics:
     _occupancy_sum: float = 0.0  # Σ (active rows / slots) over decode steps
     _finished: Deque[RequestTiming] = field(default_factory=deque)
     # wall-clock histograms (bounded deques, window entries each). These
-    # are measured with time.perf_counter by the engine, NEVER the
-    # injectable engine clock: fake-clock latency tests must not see
-    # extra clock reads, and dispatch overhead is a real-time quantity.
+    # are measured by the engine's ``perf_clock`` (time.perf_counter by
+    # default — dispatch overhead is a real-time quantity), NEVER the
+    # lifecycle ``clock``: fake-clock latency tests must not see extra
+    # clock reads. Fleet trace replay injects a simulated perf_clock so
+    # the histograms are deterministic in tier-1.
     _itl: Deque[float] = field(default_factory=deque)       # s per token
     _dispatch: Deque[float] = field(default_factory=deque)  # host s per token
     _chunk_stall: Deque[float] = field(default_factory=deque)  # s per chunk
     _accept_rate: Deque[float] = field(default_factory=deque)  # per round
     _spec_tokens: Deque[float] = field(default_factory=deque)  # emitted/row
+    # per-tenant accounting keyed by adapter_id: fairness must be
+    # OBSERVABLE (the fleet bench asserts tenant isolation off this), so
+    # every submit/admission/terminal event also lands in its tenant's row
+    _tenants: Dict[int, Dict[str, object]] = field(default_factory=dict)
+
+    def _tenant(self, adapter_id: int) -> Dict[str, object]:
+        row = self._tenants.get(int(adapter_id))
+        if row is None:
+            row = {"submitted": 0, "admitted": 0, "tokens": 0,
+                   "finished": Counter()}
+            self._tenants[int(adapter_id)] = row
+        return row
 
     def observe_reject(self, reason: str) -> None:
         self.rejected[reason] += 1
 
-    def observe_cancel(self, reason: str) -> None:
-        """One request terminated early: ``"deadline"`` (engine reaped it)
-        or ``"cancelled"`` (caller asked)."""
+    def observe_cancel(self, reason: str, adapter_id: int = 0,
+                       tokens: int = 0) -> None:
+        """One request terminated early: ``"deadline"`` (engine reaped it),
+        ``"cancelled"`` (caller asked), or ``"shed"`` (deadline provably
+        unmeetable at admission time — dropped before it cost a slot)."""
         self.cancelled[reason] += 1
+        row = self._tenant(adapter_id)
+        row["finished"][reason] += 1
+        row["tokens"] += int(tokens)
 
     def observe_result_evicted(self) -> None:
         self.results_evicted += 1
 
-    def observe_submit(self) -> None:
+    def observe_submit(self, adapter_id: int = 0) -> None:
         self.submitted += 1
+        self._tenant(adapter_id)["submitted"] += 1
 
     def observe_swap(self, version: int) -> None:
         """One hot weight swap; ``version`` is the version now serving
@@ -143,8 +164,9 @@ class ServingMetrics:
         self.weight_swaps += 1
         self.weights_version = int(version)
 
-    def observe_prefill(self) -> None:
+    def observe_prefill(self, adapter_id: int = 0) -> None:
         self.prefills += 1
+        self._tenant(adapter_id)["admitted"] += 1
 
     def observe_decode_step(self, n_active: int) -> None:
         self.decode_steps += 1
@@ -214,9 +236,13 @@ class ServingMetrics:
         if chunk_s is not None and stalled_slots > 0:
             self._push(self._chunk_stall, chunk_s)
 
-    def observe_finish(self, timing: RequestTiming) -> None:
+    def observe_finish(self, timing: RequestTiming,
+                       adapter_id: int = 0) -> None:
         self.completed += 1
         self.tokens_generated += timing.generated_tokens
+        row = self._tenant(adapter_id)
+        row["finished"][timing.finish_reason or "eos"] += 1
+        row["tokens"] += int(timing.generated_tokens)
         self._finished.append(timing)
         while len(self._finished) > self.window:
             self._finished.popleft()
@@ -298,6 +324,16 @@ class ServingMetrics:
                 "emitted_per_row_per_round": self._dist(
                     list(self._spec_tokens)),
             })
+        # per-tenant accounting (JSON object keys must be strings)
+        out["tenants"] = {
+            str(aid): {
+                "submitted": row["submitted"],
+                "admitted": row["admitted"],
+                "tokens": row["tokens"],
+                "finished": dict(row["finished"]),
+            }
+            for aid, row in sorted(self._tenants.items())
+        }
         if memory is not None:
             out["memory"] = memory
         return out

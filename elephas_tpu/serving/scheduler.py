@@ -17,6 +17,16 @@ step. Prefill-first maximizes batch occupancy (a freshly admitted row
 joins every subsequent decode step) and minimizes TTFT; the decode batch
 it momentarily delays loses one step of latency, which continuous
 batching amortizes across the whole rollout.
+
+Admission is deadline-aware: before each decide the engine sheds queued
+requests that provably cannot meet their ``deadline_s``
+(:meth:`Scheduler.unmeetable` — deadline already expired, or the
+remaining token budget times the engine's per-token latency floor
+overruns it) with a distinct ``"shed"`` finish reason, instead of
+admitting them and reaping them late. Shedding hopeless work at the
+queue is what keeps slots for requests that can still succeed — the
+load-shedding discipline the fleet policy layer
+(:mod:`elephas_tpu.fleet.policy`) extends across partitions.
 """
 
 from __future__ import annotations
@@ -155,6 +165,28 @@ class Scheduler:
             and entry[2].deadline_at is not None
             and now >= entry[2].deadline_at
         ]
+
+    def unmeetable(self, now: float,
+                   itl_s: Optional[float] = None) -> List[ServingRequest]:
+        """Queued requests that PROVABLY cannot meet their deadline: the
+        deadline already passed, or — given a per-token latency floor
+        ``itl_s`` — even emitting at that floor overruns it
+        (``now + remaining_budget * itl_s > deadline_at``). The engine
+        sheds these at decide time with ``finish_reason="shed"`` instead
+        of admitting them and reaping them late: a request that cannot
+        finish should never cost a slot, a prefill, or the decode batch a
+        row. NOT yet discarded — the caller owns the terminal record."""
+        out = []
+        for entry in self._heap:
+            req = entry[2]
+            if req.cancelled or req.deadline_at is None:
+                continue
+            budget = max(0, req.max_new - len(req.generated))
+            if now >= req.deadline_at or (
+                    itl_s is not None
+                    and now + budget * float(itl_s) > req.deadline_at):
+                out.append(req)
+        return out
 
     def decide(self, free_slots: int, active_slots: int,
                has_partial: bool = False,

@@ -1,0 +1,121 @@
+"""Autoscaler: deterministic scale-up on queue depth / miss rate,
+graceful scale-down when drained, cooldown and bounds honored."""
+
+import pytest
+
+import jax.numpy as jnp
+
+from elephas_tpu.fleet import (Autoscaler, FleetRouter, SimClock,
+                               TrafficModel, run_trace)
+from elephas_tpu.models.transformer import TransformerLM
+from elephas_tpu.serving import ServingEngine
+
+pytestmark = pytest.mark.fleet
+
+
+def _model():
+    return TransformerLM(vocab=17, d_model=16, n_heads=4, n_layers=2,
+                         d_ff=32, max_len=48)
+
+
+def _router(model, params, clock, n=1, n_slots=2):
+    def factory(pid):
+        return ServingEngine(model, params, n_slots=n_slots, max_queue=16,
+                             clock=clock, perf_clock=clock)
+    return FleetRouter(factory, n, clock=clock, lease_s=2.0)
+
+
+def test_scales_up_under_burst_and_back_down_when_idle():
+    model = _model()
+    params = {k: jnp.asarray(v) for k, v in model.init(seed=1).items()}
+    clock = SimClock()
+    router = _router(model, params, clock, n=1)
+    scaler = Autoscaler(router, min_partitions=1, max_partitions=4,
+                        cooldown_s=1.0, queue_high=3.0)
+    trace = TrafficModel(seed=5, base_rps=6.0, duration_s=10.0,
+                         n_tenants=4).generate()
+    run_trace(router, trace, clock=clock, step_dt=0.05, autoscaler=scaler)
+    ups = [e for e in scaler.events if e["action"] == "up"]
+    downs = [e for e in scaler.events if e["action"] == "down"]
+    assert ups, "burst load must trigger scale-up"
+    assert downs, "drained fleet must shrink back"
+    assert router.n_live == 1  # idles back to the floor
+    # scale events are membership changes; no work may be lost to them
+    snap = router.snapshot()
+    assert snap["fleet"]["done"] == len(trace)
+    assert snap["fleet"]["ok"] == len(trace)
+
+
+def test_determinism_same_trace_same_events():
+    model = _model()
+    params = {k: jnp.asarray(v) for k, v in model.init(seed=1).items()}
+    trace = TrafficModel(seed=5, base_rps=6.0, duration_s=8.0).generate()
+
+    def run_once():
+        clock = SimClock()
+        router = _router(model, params, clock, n=1)
+        scaler = Autoscaler(router, min_partitions=1, max_partitions=4,
+                            cooldown_s=1.0, queue_high=3.0)
+        run_trace(router, trace, clock=clock, step_dt=0.05,
+                  autoscaler=scaler)
+        return scaler.events
+
+    assert run_once() == run_once()
+
+
+def test_cooldown_separates_decisions():
+    model = _model()
+    params = {k: jnp.asarray(v) for k, v in model.init(seed=1).items()}
+    clock = SimClock()
+    router = _router(model, params, clock, n=1)
+    scaler = Autoscaler(router, max_partitions=8, cooldown_s=5.0,
+                        queue_high=0.5)
+    from elephas_tpu.fleet.traffic import TraceRequest
+    for i in range(12):  # deep queue, far past queue_high
+        router.submit(TraceRequest(request_id=f"r{i}", arrival_s=0.0,
+                                   tenant=0, prompt=[1, 2], max_new=4))
+    assert scaler.maybe_scale(0.0) == "up"
+    assert scaler.maybe_scale(1.0) is None      # inside cooldown
+    assert scaler.maybe_scale(5.0) == "up"      # cooldown elapsed
+    assert router.n_live == 3
+
+
+def test_bounds_are_hard():
+    model = _model()
+    params = {k: jnp.asarray(v) for k, v in model.init(seed=1).items()}
+    clock = SimClock()
+    router = _router(model, params, clock, n=1)
+    scaler = Autoscaler(router, min_partitions=1, max_partitions=1,
+                        cooldown_s=0.0, queue_high=0.5, queue_low=10.0)
+    from elephas_tpu.fleet.traffic import TraceRequest
+    for i in range(8):
+        router.submit(TraceRequest(request_id=f"r{i}", arrival_s=0.0,
+                                   tenant=0, prompt=[1, 2], max_new=4))
+    assert scaler.maybe_scale(0.0) is None      # at max: never grows
+    while router.active:
+        router.step()
+        clock.advance(0.05)
+    assert scaler.maybe_scale(10.0) is None     # at min: never shrinks
+    assert router.n_live == 1
+    with pytest.raises(ValueError):
+        Autoscaler(router, min_partitions=2, max_partitions=1)
+
+
+def test_miss_rate_signal_triggers_scale_up():
+    """Queue shallow but the window's deadline completions mostly
+    missed: the miss-rate confirmation signal alone must scale up."""
+    model = _model()
+    params = {k: jnp.asarray(v) for k, v in model.init(seed=1).items()}
+    clock = SimClock()
+    router = _router(model, params, clock, n=1)
+    scaler = Autoscaler(router, max_partitions=4, cooldown_s=0.0,
+                        queue_high=1e9, miss_rate_high=0.5)
+    from elephas_tpu.fleet.traffic import TraceRequest
+    # an impossible deadline: sheds, counting as a window miss
+    router.submit(TraceRequest(request_id="m0", arrival_s=0.0, tenant=0,
+                               prompt=[1, 2], max_new=4, deadline_s=0.01))
+    clock.advance(1.0)
+    router.step()  # policy sheds m0
+    assert router.results()["m0"].finish_reason == "shed"
+    assert scaler.window_miss_rate() == 1.0
+    assert scaler.maybe_scale(clock()) == "up"

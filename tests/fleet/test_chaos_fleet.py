@@ -1,0 +1,146 @@
+"""The pinned fleet chaos scenario (ISSUE 17 acceptance): a bursty
+multi-tenant trace with a partition KILLED mid-run and a replacement
+joining — deterministically, on the SimClock, asserting:
+
+- every request reaches a terminal state (nothing lost to the crash),
+- p99 deadline misses stay bounded (asserted threshold),
+- surviving AND migrated streams emit zero divergent tokens vs the
+  undisturbed baseline run,
+- on paged partitions, page accounting is exact (``kv.check()``) at
+  every fleet step throughout the kill/join churn.
+
+Everything here is ``chaos``-marked alongside the resilience suite's
+pinned scenarios, and ``fleet``-marked for `make test-fleet`.
+"""
+
+import pytest
+
+import jax.numpy as jnp
+
+from elephas_tpu.fleet import (FleetPolicy, FleetRouter, SimClock,
+                               TrafficModel, run_trace)
+from elephas_tpu.models.transformer import TransformerLM
+from elephas_tpu.serving import ServingEngine
+
+pytestmark = [pytest.mark.fleet, pytest.mark.chaos]
+
+KILL_AT = 2.0     # mid-burst: partition 0 dies with requests in flight
+JOIN_AT = 2.5     # replacement joins before the backlog drains
+STEP_DT = 0.05
+MISS_BOUND = 0.1  # ≤10% of deadline-carrying requests may miss p99-style
+
+
+def _model():
+    return TransformerLM(vocab=17, d_model=16, n_heads=4, n_layers=2,
+                         d_ff=32, max_len=48)
+
+
+def _trace():
+    # bursty + multi-tenant + a sampled fraction, exactly the harness's
+    # point: interactive tenants carry deadlines, batch tenants don't
+    return TrafficModel(seed=3, base_rps=4.0, duration_s=12.0,
+                        n_tenants=4, sampled_frac=0.5,
+                        burst_amp=2.0).generate()
+
+
+def _run(trace, *, paged, chaos, check_every_step=False):
+    clock = SimClock()
+
+    def factory(pid):
+        return ServingEngine(_model.model, _model.params, n_slots=4,
+                             max_queue=8, paged=paged, page_size=4,
+                             clock=clock, perf_clock=clock)
+
+    router = FleetRouter(factory, 2, policy=FleetPolicy(), clock=clock,
+                         lease_s=0.5)
+    if not check_every_step:
+        snap = run_trace(router, trace, clock=clock, step_dt=STEP_DT,
+                         chaos=chaos)
+        return router, snap
+    # hand-rolled replay loop so kv.check() runs after EVERY fleet step
+    pending = sorted(trace.requests, key=lambda r: r.arrival_s)
+    events = sorted(chaos or [], key=lambda e: e["t"])
+    i = e = steps = 0
+    while True:
+        now = clock()
+        while e < len(events) and events[e]["t"] <= now:
+            ev = events[e]
+            e += 1
+            (router.kill_partition(ev["pid"]) if ev["op"] == "kill"
+             else router.join_partition())
+        while i < len(pending) and pending[i].arrival_s <= now:
+            router.submit(pending[i])
+            i += 1
+        router.step()
+        for pid in router.partition_ids():
+            router._engines[pid].kv.check()  # exact page accounting
+        if i >= len(pending) and e >= len(events) and router.active == 0:
+            break
+        clock.advance(STEP_DT)
+        steps += 1
+        assert steps < 20000
+    return router, router.snapshot()
+
+
+def setup_module():
+    _model.model = _model()
+    _model.params = {k: jnp.asarray(v)
+                     for k, v in _model.model.init(seed=1).items()}
+
+
+CHAOS = [{"t": KILL_AT, "op": "kill", "pid": 0},
+         {"t": JOIN_AT, "op": "join"}]
+
+
+def test_pinned_chaos_dense_zero_divergence_and_bounded_misses():
+    trace = _trace()
+    base_router, base = _run(trace, paged=False, chaos=None)
+    router, snap = _run(trace, paged=False, chaos=CHAOS)
+
+    # nothing lost: every request terminal, the fleet drained
+    f = snap["fleet"]
+    assert f["done"] == len(trace) and f["queued"] == 0
+    assert f["epoch_changes"] >= 2      # the kill's expiry + the join
+    assert router.migrations >= 1       # in-flight work moved
+
+    # bounded deadline misses under the kill/join churn
+    slo = snap["slo"]
+    assert slo["deadline_done"] == slo["with_deadline"]
+    miss_frac = slo["deadline_missed"] / slo["deadline_done"]
+    assert miss_frac <= MISS_BOUND, (
+        f"{slo['deadline_missed']}/{slo['deadline_done']} deadline misses")
+
+    # zero token divergence: surviving AND migrated streams
+    base_res = base_router.results()
+    chaos_res = router.results()
+    migrated = [rid for rid, st in chaos_res.items() if st.migrations > 0]
+    assert migrated, "the kill must actually migrate at least one stream"
+    for rid, st in base_res.items():
+        assert chaos_res[rid].tokens == st.tokens, f"{rid} diverged"
+    # deterministic replay: the same chaos run pins the same snapshot
+    _, snap2 = _run(trace, paged=False, chaos=CHAOS)
+    assert snap2["fleet"] == snap["fleet"]
+    assert snap2["slo"] == snap["slo"]
+
+
+@pytest.mark.slow
+def test_pinned_chaos_paged_exact_page_accounting_throughout():
+    """Same scenario on PAGED partitions, ``kv.check()`` after every
+    fleet step: the kill drops a whole partition's pages with it, the
+    join brings a fresh pool, and migration re-prefills — page refcounts
+    must stay exact through all of it."""
+    trace = _trace()
+    router, snap = _run(trace, paged=True, chaos=CHAOS,
+                        check_every_step=True)
+    f = snap["fleet"]
+    assert f["done"] == len(trace) and f["queued"] == 0
+    assert router.migrations >= 1
+    slo = snap["slo"]
+    assert (slo["deadline_missed"] / max(slo["deadline_done"], 1)
+            <= MISS_BOUND)
+    # paged vs dense identity: the same trace's streams match the dense
+    # chaos run (the engine pins paged==dense; the fleet must preserve it)
+    dense_router, _ = _run(trace, paged=False, chaos=CHAOS)
+    dense = dense_router.results()
+    for rid, st in router.results().items():
+        assert st.tokens == dense[rid].tokens, f"{rid} diverged paged/dense"

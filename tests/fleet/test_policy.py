@@ -1,0 +1,160 @@
+"""FleetPolicy: strict priority tiers, DRR fairness under Zipf skew,
+token-bucket rate limits, deadline shedding, overload backpressure."""
+
+import pytest
+
+from elephas_tpu.fleet import FleetPolicy
+from elephas_tpu.fleet.traffic import TraceRequest
+
+pytestmark = pytest.mark.fleet
+
+
+def _req(rid, tenant=0, max_new=4, priority=0, deadline_s=None,
+         arrival_s=0.0):
+    return TraceRequest(request_id=rid, arrival_s=arrival_s, tenant=tenant,
+                        prompt=[1, 2], max_new=max_new, priority=priority,
+                        deadline_s=deadline_s)
+
+
+def _drain(policy, now):
+    out = []
+    while True:
+        d = policy.poll(now)
+        if d is None:
+            return out
+        out.append(d)
+
+
+def test_higher_tier_dispatches_first():
+    p = FleetPolicy()
+    p.submit(_req("b0", tenant=0, priority=0), 0.0)
+    p.submit(_req("b1", tenant=1, priority=0), 0.0)
+    p.submit(_req("i0", tenant=2, priority=1), 0.0)
+    order = [r.request_id for kind, r in _drain(p, 0.0)]
+    assert order[0] == "i0"
+    assert set(order) == {"i0", "b0", "b1"}
+
+
+def test_drr_interleaves_heavy_and_light_tenant():
+    """Tenant 0 floods 10 requests, tenant 1 submits 2: DRR must serve
+    tenant 1 long before tenant 0's backlog drains (no FIFO starvation),
+    and equal-cost tenants alternate."""
+    p = FleetPolicy(quantum=4.0)
+    for i in range(10):
+        p.submit(_req(f"h{i}", tenant=0), 0.0)
+    p.submit(_req("l0", tenant=1), 0.0)
+    p.submit(_req("l1", tenant=1), 0.0)
+    order = [r.request_id for kind, r in _drain(p, 0.0)]
+    assert len(order) == 12
+    # both light requests land within the first four dispatches
+    assert {"l0", "l1"} <= set(order[:4])
+
+
+def test_drr_token_cost_throttles_expensive_tenant():
+    """Tenant 0's requests cost 8 tokens, tenant 1's cost 2: with a
+    quantum of 4, tenant 1 gets ~4x the REQUEST rate (equal token
+    share), so its queue drains much earlier."""
+    p = FleetPolicy(quantum=4.0)
+    for i in range(4):
+        p.submit(_req(f"e{i}", tenant=0, max_new=8), 0.0)
+        p.submit(_req(f"c{i}", tenant=1, max_new=2), 0.0)
+    order = [r.request_id for kind, r in _drain(p, 0.0)]
+    cheap_done = max(order.index(f"c{i}") for i in range(4))
+    exp_done = max(order.index(f"e{i}") for i in range(4))
+    assert cheap_done < exp_done
+    # all four cheap requests dispatch before the LAST two expensive ones
+    assert cheap_done < order.index("e2")
+
+
+def test_rate_limit_skips_until_refill():
+    """Tenant 0 limited to 2 tokens/s with burst 4: its first request
+    (4 tokens) drains the bucket; the second must wait ~2s of refill
+    while unlimited tenant 1 keeps dispatching."""
+    p = FleetPolicy(rate_limits={0: (2.0, 4.0)})
+    p.submit(_req("a0", tenant=0, max_new=4), 0.0)
+    p.submit(_req("a1", tenant=0, max_new=4), 0.0)
+    p.submit(_req("b0", tenant=1, max_new=4), 0.0)
+    got = [r.request_id for kind, r in _drain(p, 0.0)]
+    assert "a0" in got and "b0" in got and "a1" not in got
+    assert p.queue_depth == 1
+    assert _drain(p, 1.0) == []          # bucket at 2 of 4 needed
+    late = [r.request_id for kind, r in _drain(p, 2.0)]
+    assert late == ["a1"]
+
+
+def test_expired_deadline_shed_not_dispatched():
+    p = FleetPolicy()
+    p.submit(_req("d0", deadline_s=1.0, arrival_s=0.0), 0.0)
+    p.submit(_req("ok", tenant=1), 0.0)
+    out = _drain(p, 2.0)  # now past d0's absolute deadline
+    kinds = {r.request_id: kind for kind, r in out}
+    assert kinds == {"d0": "shed", "ok": "dispatch"}
+
+
+def test_unmeetable_budget_shed_with_itl_floor():
+    """Deadline not yet expired, but budget * itl floor overruns it —
+    provably hopeless, shed now; same deadline with a small budget
+    dispatches."""
+    p = FleetPolicy(itl_estimate_s=1.0)
+    p.submit(_req("hopeless", max_new=10, deadline_s=5.0), 0.0)
+    p.submit(_req("fine", tenant=1, max_new=3, deadline_s=5.0), 0.0)
+    kinds = {r.request_id: kind for kind, r in _drain(p, 0.0)}
+    assert kinds == {"hopeless": "shed", "fine": "dispatch"}
+
+
+def test_overload_sheds_at_submit():
+    p = FleetPolicy(max_queue_per_tenant=2)
+    assert p.submit(_req("q0"), 0.0) is None
+    assert p.submit(_req("q1"), 0.0) is None
+    assert p.submit(_req("q2"), 0.0) == "overload"
+    assert p.queue_depth == 2
+
+
+def test_push_front_beats_fifo_order():
+    p = FleetPolicy()
+    p.submit(_req("first"), 0.0)
+    p.submit(_req("second"), 0.0)
+    kind, r = p.poll(0.0)
+    assert r.request_id == "first"
+    p.push_front(r)  # dispatch failed: back to the front of the line
+    order = [x.request_id for kind, x in _drain(p, 0.0)]
+    assert order == ["first", "second"]
+
+
+def test_idle_tenant_banks_no_credit():
+    """A tenant whose queue drained starts from zero deficit when it
+    returns — idle time is not a savings account."""
+    p = FleetPolicy(quantum=4.0)
+    p.submit(_req("x0", tenant=0), 0.0)
+    _drain(p, 0.0)
+    for _ in range(3):
+        assert p.poll(0.0) is None  # idle sweeps reset, never accrue
+    snap = p.snapshot()
+    assert snap["tenants"]["0"]["deficit"] == 0.0
+
+
+def test_snapshot_schema_and_counts():
+    p = FleetPolicy(rate_limits={1: (5.0, 10.0)})
+    p.submit(_req("a", tenant=0), 0.0)
+    p.submit(_req("b", tenant=1, priority=1), 0.0)
+    p.submit(_req("c", tenant=0, deadline_s=0.5), 0.0)
+    out = _drain(p, 1.0)  # c sheds (expired), a and b dispatch
+    assert len(out) == 3
+    snap = p.snapshot()
+    assert snap["queued"] == 0
+    t0, t1 = snap["tenants"]["0"], snap["tenants"]["1"]
+    assert t0["enqueued"] == 2 and t0["dispatched"] == 1 and t0["shed"] == 1
+    assert t1["tier"] == 1 and t1["dispatched"] == 1
+    assert t1["rate_tokens"] is not None and t0["rate_tokens"] is None
+    for row in (t0, t1):
+        assert set(row) == {"tier", "queued", "deficit", "rate_tokens",
+                            "enqueued", "dispatched", "shed"}
+
+
+def test_bad_config_rejected():
+    with pytest.raises(ValueError):
+        FleetPolicy(quantum=0.0)
+    with pytest.raises(ValueError):
+        FleetPolicy(max_queue_per_tenant=0)
+    with pytest.raises(ValueError):
+        FleetPolicy(itl_estimate_s=-1.0)

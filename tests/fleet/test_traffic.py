@@ -1,0 +1,128 @@
+"""Traffic harness: seeded determinism, JSON replayability, and the
+three load properties the fleet is exercised against — bursty arrivals,
+heavy-tailed lengths, Zipf tenant skew."""
+
+import numpy as np
+import pytest
+
+from elephas_tpu.fleet import SimClock, Trace, TrafficModel
+from elephas_tpu.fleet.traffic import zipf_weights
+
+pytestmark = pytest.mark.fleet
+
+
+def _model(**kw):
+    cfg = dict(seed=0, base_rps=4.0, duration_s=20.0, n_tenants=6)
+    cfg.update(kw)
+    return TrafficModel(**cfg)
+
+
+def test_same_seed_bit_identical_trace():
+    a = _model().generate()
+    b = _model().generate()
+    assert a.to_json() == b.to_json()
+    assert len(a) > 10
+
+
+def test_different_seed_different_trace():
+    a = _model(seed=1).generate()
+    b = _model(seed=2).generate()
+    assert a.to_json() != b.to_json()
+
+
+def test_json_round_trip_lossless():
+    t = _model().generate()
+    t2 = Trace.from_json(t.to_json())
+    assert t2.to_json() == t.to_json()
+    assert t2.config == t.config
+    r, r2 = t.requests[0], t2.requests[0]
+    assert r2 == r  # dataclass equality: every field survives
+
+
+def test_arrivals_sorted_and_within_duration():
+    t = _model().generate()
+    arr = [r.arrival_s for r in t.requests]
+    assert arr == sorted(arr)
+    assert all(0 <= a < 20.0 for a in arr)
+
+
+def test_zipf_tenant_skew():
+    """Rank-0 tenant dominates; the head outweighs the tail (the skew
+    the DRR fairness layer exists to contain)."""
+    t = _model(duration_s=60.0, zipf_a=1.2).generate()
+    counts = t.tenants()
+    assert max(counts, key=counts.get) == 0
+    head = counts.get(0, 0) + counts.get(1, 0)
+    tail = sum(v for k, v in counts.items() if k >= 2)
+    assert head > tail
+
+
+def test_heavy_tailed_lengths():
+    """Lognormal sigma produces a genuine tail: max well above median,
+    everything within the configured clip."""
+    t = _model(duration_s=120.0, prompt_len_sigma=1.0,
+               prompt_len_max=64).generate()
+    lens = np.array([len(r.prompt) for r in t.requests])
+    assert lens.max() <= 64 and lens.min() >= 1
+    assert lens.max() >= 3 * np.median(lens)
+
+
+def test_interactive_tenants_carry_deadlines_and_priority():
+    t = _model(interactive_tenants=2, batch_deadline_s=None).generate()
+    for r in t.requests:
+        if r.tenant < 2:
+            assert r.priority == 1 and r.deadline_s is not None
+            assert r.deadline_s >= 4.0  # base + per-token margin
+        else:
+            assert r.priority == 0 and r.deadline_s is None
+
+
+def test_scaled_compresses_arrivals_only():
+    t = _model().generate()
+    s = t.scaled(2.0)
+    assert len(s) == len(t)
+    for a, b in zip(t.requests, s.requests):
+        assert b.arrival_s == pytest.approx(a.arrival_s / 2.0)
+        assert b.prompt == a.prompt and b.max_new == a.max_new
+    assert s.offered_rps == pytest.approx(2.0 * t.offered_rps)
+    assert s.config["load_scale"] == 2.0
+
+
+def test_burst_windows_raise_local_rate():
+    """With a huge burst amplitude the burst windows must be visibly
+    denser than the off-burst background."""
+    m = _model(seed=11, duration_s=60.0, burst_amp=9.0, diurnal_amp=0.0,
+               burst_every_s=20.0, burst_width_s=5.0)
+    rng = np.random.default_rng(m.cfg["seed"])
+    windows = m._burst_windows(rng)
+    t = m.generate()
+    assert windows, "seed must produce at least one burst window"
+    in_w = sum(1 for r in t.requests
+               if any(lo <= r.arrival_s < hi for lo, hi in windows))
+    out_w = len(t) - in_w
+    w_span = sum(hi - lo for lo, hi in windows)
+    o_span = 60.0 - w_span
+    assert in_w / max(w_span, 1e-9) > 3.0 * (out_w / max(o_span, 1e-9))
+
+
+def test_zipf_weights_normalized_and_monotone():
+    w = zipf_weights(8, 1.1)
+    assert w.sum() == pytest.approx(1.0)
+    assert all(w[i] > w[i + 1] for i in range(7))
+
+
+def test_sim_clock_explicit_advance_only():
+    c = SimClock(5.0)
+    assert c() == 5.0 and c() == 5.0  # reading never advances
+    assert c.advance(1.5) == 6.5 and c() == 6.5
+    with pytest.raises(ValueError):
+        c.advance(-1.0)
+
+
+def test_bad_config_rejected():
+    with pytest.raises(ValueError):
+        TrafficModel(base_rps=0.0)
+    with pytest.raises(ValueError):
+        TrafficModel(diurnal_amp=1.0)
+    with pytest.raises(ValueError):
+        _model().generate().scaled(0.0)

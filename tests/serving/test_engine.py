@@ -294,6 +294,7 @@ def test_cancel_queued_never_occupies_slot():
     eng = ServingEngine(model, params, n_slots=1, max_queue=8)
     busy = eng.submit(p, 4)
     eng.step()                               # busy takes the only slot
+    assert eng.kv.free_slots == 0
     doomed = eng.submit(p, 4)
     assert eng.scheduler.queue_depth == 1
     assert eng.cancel(doomed)
@@ -302,12 +303,17 @@ def test_cancel_queued_never_occupies_slot():
     assert eng.result(doomed).tokens == []   # never ran
     assert fin[busy].finish_reason == "length"
     assert eng.snapshot()["engine"]["prefills"] == 1
+    # no slot leak: the cancel never touched the slot budget, and the
+    # drain returned busy's slot
+    assert eng.kv.free_slots == 1 and eng.kv.active_slots == 0
 
 
-def test_deadline_reaps_queued_request():
-    """A request that times out while still QUEUED is reaped with zero
-    tokens and never admitted — the slot goes to work that can still meet
-    its deadline."""
+def test_deadline_expired_in_queue_is_shed_not_reaped():
+    """A request that times out while still QUEUED is SHED with zero
+    tokens and a distinct ``"shed"`` finish reason — it never cost a
+    slot, which is different from a ``"deadline"`` reap of admitted
+    work (callers can retry a shed against another replica). The slot
+    goes to work that can still meet its deadline."""
     model = _model()
     params = _params(model)
     rng = np.random.default_rng(14)
@@ -316,10 +322,68 @@ def test_deadline_reaps_queued_request():
     busy = eng.submit(p, 6)
     doomed = eng.submit(p, 6, deadline_s=2.0)   # FakeClock: +1s per call
     fin = eng.drain(max_steps=200)
-    assert fin[doomed].finish_reason == "deadline"
+    assert fin[doomed].finish_reason == "shed"
     assert fin[doomed].tokens == []
     assert fin[busy].finish_reason == "length"
-    assert eng.snapshot()["counters"]["cancelled"] == {"deadline": 1}
+    assert eng.snapshot()["counters"]["cancelled"] == {"shed": 1}
     with pytest.raises(AdmissionError) as ei:
         eng.submit(p, 2, deadline_s=0.0)
     assert ei.value.reason == "bad_request"
+
+
+def test_shed_at_admission_when_budget_provably_overruns():
+    """With an ``itl_estimate_s`` latency floor, a queued request whose
+    remaining budget times the floor overruns its deadline is shed at
+    decide() BEFORE it wastes a prefill — even though the deadline has
+    not expired yet. A meetable request with the same deadline admits
+    and finishes."""
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(15)
+    p = rng.integers(0, V, size=(4,)).astype(np.int32)
+    eng = ServingEngine(model, params, n_slots=2, clock=FakeClock(),
+                        itl_estimate_s=10.0)
+    # deadline 60 fake-seconds out: 8 tokens * 10 s/token = 80 > 60
+    hopeless = eng.submit(p, 8, deadline_s=60.0)
+    fine = eng.submit(p, 3, deadline_s=60.0)    # 30 < 60: provably fine
+    fin = eng.drain(max_steps=200)
+    assert fin[hopeless].finish_reason == "shed"
+    assert fin[hopeless].tokens == []
+    assert fin[fine].finish_reason == "length"
+    assert eng.snapshot()["engine"]["prefills"] == 1  # hopeless never ran
+    with pytest.raises(ValueError):
+        ServingEngine(model, params, itl_estimate_s=0.0)
+
+
+def test_injectable_perf_clock_makes_histograms_deterministic():
+    """The latency histograms (dispatch overhead etc.) read the engine's
+    ``perf_clock``, not a hard-coded ``perf_counter``: injecting a
+    deterministic clock makes two identical runs produce bit-identical
+    histogram sections — the property fleet trace replay relies on."""
+
+    class CountingClock:
+        def __init__(self):
+            self.t = 0.0
+
+        def __call__(self):
+            self.t += 0.25
+            return self.t
+
+    def run_once():
+        model = _model()
+        params = _params(model)
+        rng = np.random.default_rng(21)
+        eng = ServingEngine(model, params, n_slots=2, clock=FakeClock(),
+                            perf_clock=CountingClock())
+        for i, (p, n) in enumerate(_mixed_requests(rng, 3)):
+            eng.submit(p, n, request_id=f"r{i}")
+        eng.drain(max_steps=300)
+        return eng.snapshot()
+
+    a, b = run_once(), run_once()
+    assert a == b                            # the WHOLE snapshot pins
+    d = a["fastpath"]["dispatch_overhead_s"]
+    assert d["count"] > 0
+    # every sample derives from the injected clock's 0.25 grid, so the
+    # percentiles are exact multiples of it — impossible with perf_counter
+    assert d["p50"] % 0.25 == 0

@@ -84,3 +84,25 @@ def test_finished_window_is_bounded():
         m.observe_finish(_timing(rid=f"r{i}", gen=1))
     assert m.completed == 10                   # counter keeps the total
     assert m.snapshot()["requests"]["ttft_s"]["count"] == 3
+
+
+def test_per_tenant_accounting_in_snapshot():
+    """The tenants section attributes submits, admissions, tokens, and
+    finish reasons (including sheds) to each adapter_id — the fleet's
+    fairness observability rides on these counters."""
+    m = ServingMetrics(n_slots=2)
+    m.observe_submit(adapter_id=1)
+    m.observe_submit(adapter_id=1)
+    m.observe_submit(adapter_id=2)
+    m.observe_prefill(adapter_id=1)
+    m.observe_finish(_timing(rid="a", gen=4), adapter_id=1)
+    m.observe_cancel("shed", adapter_id=2, tokens=0)
+    m.observe_cancel("deadline", adapter_id=1, tokens=3)
+    snap = json.loads(json.dumps(m.snapshot()))
+    t1, t2 = snap["tenants"]["1"], snap["tenants"]["2"]
+    assert t1 == {"submitted": 2, "admitted": 1, "tokens": 7,
+                  "finished": {"length": 1, "deadline": 1}}
+    assert t2 == {"submitted": 1, "admitted": 0, "tokens": 0,
+                  "finished": {"shed": 1}}
+    # tenant keys sort numerically-as-strings for stable JSON diffs
+    assert list(snap["tenants"]) == ["1", "2"]

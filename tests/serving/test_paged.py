@@ -233,6 +233,49 @@ def test_cancel_mid_decode_returns_pages():
     assert eng.kv.memory_stats()["pages_used"] == 0
 
 
+def test_cancel_queued_request_holds_no_pages():
+    """Cancel a still-QUEUED (never admitted) request on the paged
+    engine: it holds no slot and no page refs yet, so the cancel must
+    change NOTHING in the allocator — ``pages_used`` identical before
+    and after, ``kv.check()`` exact — and survivors sharing its would-be
+    prefix stream unperturbed, leaving only clean prefix-cache pages."""
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(16)
+    system = rng.integers(0, V, size=(16,)).astype(np.int32)
+    prompts = [np.concatenate([system,
+                               rng.integers(0, V, size=(4,)).astype(np.int32)])
+               for _ in range(4)]
+    eng = ServingEngine(model, params, n_slots=2, paged=True, page_size=8)
+    admitted = [eng.submit(prompts[i], 10, request_id=f"a{i}")
+                for i in range(2)]
+    for _ in range(4):
+        eng.step()                       # both admitted, slots full
+    assert eng.kv.free_slots == 0
+    queued = [eng.submit(prompts[i], 10, request_id=f"q{i}")
+              for i in (2, 3)]
+    assert eng.scheduler.queue_depth == 2
+    used_before = eng.kv.memory_stats()["pages_used"]
+    assert eng.cancel(queued[0])
+    eng.kv.check()                       # refcounts exact after cancel
+    assert eng.kv.memory_stats()["pages_used"] == used_before
+    assert eng.scheduler.queue_depth == 1
+    rec = eng.result(queued[0])
+    assert rec.finish_reason == "cancelled" and rec.tokens == []
+    eng.drain(max_steps=5000)
+    eng.kv.check()
+    # survivors and the still-queued sibling: bitwise per-request identity
+    for i, rid in ((0, admitted[0]), (1, admitted[1]), (3, queued[1])):
+        ref = np.asarray(model.generate(params, prompts[i][None], 10))
+        assert eng.result(rid).tokens == ref[0, len(prompts[i]):].tolist()
+    # every request-held ref released; only clean prefix pages remain
+    stats = eng.kv.memory_stats()
+    assert stats["pages_used"] == stats["prefix"]["nodes"]
+    eng.kv.evict_pages(0, stats["pages_total"])
+    assert eng.kv.memory_stats()["pages_used"] == 0
+    eng.kv.check()
+
+
 def test_chaos_deadline_reaps_decref_shared_prefix():
     """A ``FaultPlan`` stall kills requests mid-decode via their
     deadlines; the reaps must return every page INCLUDING decrefs of
