@@ -8,8 +8,8 @@ TEST_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 KERAS_BACKEND=jax
 
 .PHONY: test test-fast test-chaos test-perf test-spec test-streaming \
-	test-fleet bench bench-serving bench-paged bench-lm bench-spec \
-	bench-fleet
+	test-fleet test-elastic bench bench-serving bench-paged bench-lm \
+	bench-spec bench-fleet bench-elastic
 
 test:
 	$(TEST_ENV) bash scripts/run_tests.sh -x -q
@@ -44,6 +44,12 @@ test-streaming:
 test-fleet:
 	ELEPHAS_TEST_GROUP=fleet $(TEST_ENV) bash scripts/run_tests.sh -x -q
 
+# Elastic multi-host control-plane pins only (subprocess host emulation:
+# epoch fencing, mesh re-formation on SIGKILL/partition/late-join, and
+# the pinned 2→4→3-host SparkModel.fit chaos scenario).
+test-elastic:
+	ELEPHAS_TEST_GROUP=elastic $(TEST_ENV) bash scripts/run_tests.sh -x -q
+
 bench:
 	KERAS_BACKEND=jax python bench.py
 
@@ -76,6 +82,15 @@ bench-paged:
 bench-fleet:
 	JAX_PLATFORMS=cpu KERAS_BACKEND=jax python -c "import json, bench; \
 	print(json.dumps({'fleet': bench.bench_fleet(3)}))"
+
+# Elasticity bench only: time-to-recover after a real host SIGKILL (epoch
+# bump → first post-re-formation commit) and throughput retained at
+# 3-of-4 hosts vs 4-of-4, on the subprocess emulation harness.
+# JAX_PLATFORMS=cpu: the judged numbers are control-plane recovery
+# latency, not accelerator throughput.
+bench-elastic:
+	JAX_PLATFORMS=cpu KERAS_BACKEND=jax python -c "import json, bench; \
+	print(json.dumps({'elasticity': bench.bench_elasticity(3)}))"
 
 # LM section only, forced on (BENCH_LM=1 runs it even off-TPU): the judged
 # geometry with per-phase timing (fwd_ms / bwd_reduce_ms / apply_ms /

@@ -1407,6 +1407,120 @@ def bench_fleet(reps: int):
     }
 
 
+def bench_elasticity(reps: int):
+    """Elastic multi-host control plane: recovery latency and retained
+    throughput, measured against REAL host processes (the subprocess
+    emulation harness — real SIGKILL, real reconnect, real TCP).
+
+    One chaos run answers both judged questions. A 4-host pool fits with
+    compute proportional to its shard (``sleep_per_sample_s``); the seeded
+    FaultPlan SIGKILLs one host mid-round. Off the two timestamped logs
+    (registry events + commit log, same clock):
+
+    1. time-to-recover: the expire event (epoch bump) -> the first commit
+       under the post-re-formation epoch, best over ``reps`` runs;
+    2. throughput retained at 3-of-4 hosts: steady-state samples/sec after
+       recovery vs before the kill (per-round durations from consecutive
+       commit stamps; the boot round and the kill round are excluded).
+       The analytic ideal for the task's compute model rides in the JSON
+       — the gap to it is the re-formed mesh's control-plane overhead.
+
+    CPU-runnable and deterministic in SHAPE (trace, commit log) at the
+    fixed seed; only the latencies are wall-clock. Skip with
+    BENCH_ELASTICITY=0; knobs via BENCH_ELASTIC_{ROUNDS,SAMPLES,PERSAMP}.
+    """
+    import numpy as np
+
+    if os.environ.get("BENCH_ELASTICITY", "1") == "0":
+        log("elasticity bench: skipped (BENCH_ELASTICITY=0)")
+        return None
+
+    from elephas_tpu.parallel.elastic import ElasticConfig, ElasticHostPool
+    from elephas_tpu.resilience.faults import FaultPlan
+
+    def knob(name, default, cast=int):
+        return cast(os.environ.get(f"BENCH_ELASTIC_{name.upper()}", default))
+
+    rounds = knob("rounds", 8)
+    n = knob("samples", 2048)
+    per_sample_s = knob("persamp", 0.0005, float)
+    fixed_s = 0.2          # guarantees the SIGKILL lands mid-compute
+    kill_round = rounds // 2
+
+    rng = np.random.default_rng(0)
+    w_true = rng.normal(size=16)
+    x = rng.normal(size=(n, 16))
+    y = x @ w_true
+
+    def run_chaos():
+        plan = FaultPlan(seed=0, kill_hosts={kill_round: 3})
+        pool = ElasticHostPool(
+            [np.zeros(16)],
+            ElasticConfig(initial_hosts=4, rounds=rounds, lease_s=2.0,
+                          beat_interval_s=0.05),
+            task={"builtin": "sgd_task"},
+            task_config={"lr": 0.1, "sleep_s": fixed_s,
+                         "sleep_per_sample_s": per_sample_s},
+            fault_plan=plan,
+        )
+        pool.fit(x, y)
+        return pool
+
+    best = None
+    for rep in range(max(1, reps)):
+        pool = run_chaos()
+        events = pool.registry.snapshot()["events"]
+        expire = next(e for e in events if e["kind"] == "expire")
+        # first commit under the post-re-formation epoch
+        recommit = next(c for c in pool.commit_log
+                        if c["epoch"] >= expire["epoch"])
+        recover_s = recommit["at"] - expire["at"]
+
+        # steady-state per-round durations from consecutive commit stamps;
+        # skip the boot round and the kill round (it contains the recovery)
+        stamps = [c["at"] for c in pool.commit_log]
+        durs = [b - a for a, b in zip(stamps, stamps[1:])]
+        kill_i = pool.commit_log.index(recommit) - 1
+        pre = durs[:kill_i]
+        post = durs[kill_i + 1:]
+        sps_pre = n / (sum(pre) / len(pre))
+        sps_post = n / (sum(post) / len(post))
+        row = {
+            "recover_s": round(recover_s, 3),
+            "samples_per_sec_4_hosts": round(sps_pre, 1),
+            "samples_per_sec_3_hosts": round(sps_post, 1),
+            "throughput_retained": round(sps_post / sps_pre, 3),
+            "reformations": pool.stats["reformations"],
+            "commits": len(pool.commit_log),
+        }
+        log(f"elasticity rep {rep}: recover {row['recover_s']}s, "
+            f"retained {row['throughput_retained']} "
+            f"({row['samples_per_sec_3_hosts']:.0f}/"
+            f"{row['samples_per_sec_4_hosts']:.0f} samples/sec)")
+        # sanity: the chaos shape itself must be the pinned one
+        assert pool.stats["reformations"] == 1
+        assert len(pool.commit_log) == rounds
+        assert pool.ps.version == rounds
+        if best is None or row["recover_s"] < best["recover_s"]:
+            best = row
+
+    # Analytic ideal for this compute model: per-round time is
+    # sleep_s + (n/hosts) * per_sample_s, so losing one of four hosts
+    # retains (sleep_s + n/4*ps) / (sleep_s + n/3*ps) — the fixed
+    # component does not shrink with host count.
+    ideal = ((fixed_s + n / 4 * per_sample_s)
+             / (fixed_s + n / 3 * per_sample_s))
+    return {
+        "metric": "elastic_recover_after_host_kill_s",
+        "value": best["recover_s"],
+        "unit": "s",
+        "throughput_retained_3_of_4": best["throughput_retained"],
+        "retained_ideal": round(ideal, 3),
+        "detail": best,
+        "config": f"h4-r{rounds}-n{n}-ps{per_sample_s}",
+    }
+
+
 def make_model(input_dim, nb_classes):
     import keras
 
@@ -1622,6 +1736,16 @@ def main():
         fleet = None
     if fleet is not None:
         result["fleet"] = fleet
+        print(json.dumps(result), flush=True)
+
+    # -- elasticity phase: host-kill recovery + retained throughput -------
+    try:
+        elasticity = bench_elasticity(reps)
+    except Exception as e:
+        log(f"elasticity bench failed: {type(e).__name__}: {e}")
+        elasticity = None
+    if elasticity is not None:
+        result["elasticity"] = elasticity
         print(json.dumps(result), flush=True)
 
     # -- LM phase: FLOPs-accounted tokens/sec + MFU on the same chip ------

@@ -4,6 +4,8 @@ from .composite import (
     build_mesh_3d,
 )
 from .distributed import global_mesh, hybrid_mesh, initialize_cluster
+from .elastic import ElasticConfig, ElasticHostPool
+from .emulation import EmulationBackend, JaxPodBackend
 from .engine import CompiledTrainer, FitResult
 from .expert import (
     EXPERT_AXIS,
@@ -57,4 +59,8 @@ __all__ = [
     "initialize_cluster",
     "global_mesh",
     "hybrid_mesh",
+    "ElasticConfig",
+    "ElasticHostPool",
+    "EmulationBackend",
+    "JaxPodBackend",
 ]

@@ -19,18 +19,31 @@ from __future__ import annotations
 import os
 from typing import Optional
 
-from ..utils.sockets import determine_master
+from ..utils.sockets import connect_with_retry, determine_master
 
 
 def initialize_cluster(coordinator_address: Optional[str] = None,
                        num_processes: Optional[int] = None,
                        process_id: Optional[int] = None,
-                       port: int = 8476) -> None:
+                       port: int = 8476,
+                       timeout_s: Optional[float] = None) -> None:
     """Join (or trivially skip) the multi-host JAX cluster.
 
     Resolution order for the coordinator mirrors the reference's master
     discovery: explicit argument > ``ELEPHAS_MASTER``/``SPARK_LOCAL_IP`` env
     (via :func:`determine_master`) > single-process no-op.
+
+    ``timeout_s`` bounds the join. ``jax.distributed.initialize`` against an
+    unreachable coordinator otherwise blocks indefinitely (its own
+    ``initialization_timeout`` only governs an established connection), so a
+    mistyped address turns a fleet bring-up into a silent hang. With a
+    timeout set, non-coordinator processes first *probe* the coordinator
+    endpoint with bounded exponential-backoff retries
+    (:func:`~elephas_tpu.utils.sockets.connect_with_retry`) and raise a
+    ``RuntimeError`` naming the coordinator address when it cannot be
+    reached; the remaining budget is then passed to JAX as its
+    ``initialization_timeout``. The coordinator process (id 0) skips the
+    probe — it is the one about to bind that endpoint.
     """
     import jax
 
@@ -42,10 +55,29 @@ def initialize_cluster(coordinator_address: Optional[str] = None,
         process_id = int(os.environ.get("ELEPHAS_PROCESS_ID", "0"))
     if coordinator_address is None:
         coordinator_address = determine_master(port)
+    kwargs = {}
+    if timeout_s is not None:
+        import time
+
+        start = time.monotonic()
+        if process_id != 0:
+            try:
+                probe = connect_with_retry(coordinator_address,
+                                           timeout_s=float(timeout_s))
+            except RuntimeError as err:
+                raise RuntimeError(
+                    f"process {process_id} could not join the cluster: "
+                    f"coordinator {coordinator_address} unreachable "
+                    f"({err})"
+                ) from err
+            probe.close()
+        remaining = max(1, int(float(timeout_s) - (time.monotonic() - start)))
+        kwargs["initialization_timeout"] = remaining
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
+        **kwargs,
     )
 
 

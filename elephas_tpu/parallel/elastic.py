@@ -1,0 +1,616 @@
+"""Elastic multi-host training: the driver as control plane.
+
+The rebuild thesis (PAPER.md) is a Spark driver orchestrating per-host JAX
+processes. This module is where that becomes *elastic*: the driver owns an
+:class:`ElasticHostPool` — one worker **process** per host, each leasing its
+membership through :class:`~elephas_tpu.resilience.membership.
+HeartbeatRegistry` — and survives hosts joining, leaving, and dying mid-fit.
+It is PR 3's lease/epoch machinery promoted from thread-level partitions to
+governing real host processes, the SparkNet/DeepSpark (PAPERS.md)
+sweep-and-recover pattern made elastic.
+
+The guarantees, and what enforces each:
+
+**Membership epochs.** Every join/leave/expiry bumps the registry's
+monotonic epoch. A training round is issued under one epoch and every
+contribution is stamped with it; the round can only commit at the epoch it
+was issued under.
+
+**Mesh re-formation.** On any membership change mid-round the in-flight
+round is abandoned and *re-issued* over the survivors: shards are recut
+(weighted by each host's device count — the global device count genuinely
+changes mid-fit) and a fresh epoch governs the retry. ``mesh_history``
+records each formation, so an elastic 2→4→3 fit leaves a pinnable trail.
+
+**Epoch fencing = no double-apply.** Commits go through the parameter
+server's attempt machinery (`server.py`): each issue calls
+``register_attempt(round_task_id(r), attempt=epoch)`` and the commit is
+``apply_delta(..., attempt=epoch)``. A zombie host's delta — computed under
+a fenced epoch, arriving after the re-formation committed — hits the
+server's attempt fence and lands in ``rejected_stale``, never the weights.
+A survivor's pre-re-formation delta is discarded at the pool
+(``discarded_reformation``) before it can reach the server at all.
+
+**Committed-update monotonicity.** The server's ``version`` counter bumps
+exactly once per committed round; the pool's ``commit_log`` records
+``(version, epoch, round, contributors)`` per commit and the pool *verifies*
+each commit advanced the version by exactly one — a lost or double-applied
+committed update is a hard error, not a silent drift.
+
+Determinism: all chaos comes from a seeded
+:class:`~elephas_tpu.resilience.faults.FaultPlan` (``kill_hosts`` /
+``partition_hosts`` / ``join_delay_rounds``, all exact round→host maps), and
+only the pool's main loop mutates the registry — socket reader threads just
+enqueue — so the membership-event trace ``[(kind, member), ...]`` is
+reproducible at fixed seed and pinnable in tests.
+
+Transport: on CPU the pool drives the :class:`~elephas_tpu.parallel.
+emulation.EmulationBackend` — real subprocesses, real SIGKILLs, gradients
+exchanged through the driver-side proxy collective over the ``utils/
+sockets.py`` framing. On a real pod the same pool drives
+:class:`~elephas_tpu.parallel.emulation.JaxPodBackend` geometry +
+``initialize_cluster`` bootstraps instead. See ``docs/DISTRIBUTED.md`` for
+the matrix.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+import numpy as np
+
+from ..parameter.server import BaseParameterServer
+from ..resilience.membership import HeartbeatRegistry, MembershipEvent
+from ..utils import sockets as socket_utils
+from ..worker import round_task_id
+from .emulation import EmulationBackend, JaxPodBackend  # noqa: F401 (re-export)
+
+
+def host_member(host_id: int) -> str:
+    """Registry member id for a host (mirrors ``member_id_for`` one layer
+    down: partitions are thread-level members, hosts are process-level)."""
+    return f"host-{int(host_id)}"
+
+
+@dataclass
+class ElasticConfig:
+    """Geometry + pacing of one elastic fit.
+
+    ``scale_schedule`` maps round index → target host count: the pool spawns
+    (or retires) hosts at that round's boundary, which is how a 2→4 scale-up
+    is scripted. Scale-*down* by crash is not scheduled here — that is the
+    :class:`~elephas_tpu.resilience.faults.FaultPlan`'s job (``kill_hosts``).
+    """
+
+    initial_hosts: int = 2
+    devices_per_host: int = 1
+    rounds: int = 4
+    scale_schedule: Dict[int, int] = field(default_factory=dict)
+    min_hosts: int = 1
+    lease_s: float = 2.0
+    beat_interval_s: float = 0.2
+    round_timeout_s: float = 120.0
+    join_timeout_s: float = 60.0
+    backend: str = "emulation"          # 'emulation' | 'jax'
+    python: Optional[str] = None        # interpreter for emulated hosts
+    bind_host: str = "127.0.0.1"
+    coordinator_address: Optional[str] = None   # jax backend only
+    quiet_workers: bool = True
+
+
+class _RoundState:
+    """One *issue* of a round: epoch-stamped expectations and arrivals."""
+
+    __slots__ = ("epoch", "round", "expected", "contribs")
+
+    def __init__(self, epoch: int, round_index: int, expected: Set[int]):
+        self.epoch = int(epoch)
+        self.round = int(round_index)
+        self.expected = set(expected)
+        self.contribs: Dict[int, Dict[str, Any]] = {}
+
+
+class ElasticHostPool:
+    """Driver-side control plane over one worker process per host.
+
+    Single-threaded where it matters: reader threads (one per host
+    connection) only enqueue onto the control queue; every registry
+    mutation, admission decision, and commit happens on the thread that
+    calls :meth:`fit`. That is what makes the membership-event trace and
+    the commit log deterministic at a fixed fault-plan seed.
+    """
+
+    def __init__(self, weights: List[np.ndarray],
+                 config: Optional[ElasticConfig] = None, *,
+                 task: Optional[Dict[str, Any]] = None,
+                 task_config: Optional[Dict[str, Any]] = None,
+                 fault_plan: Any = None,
+                 server: Optional[BaseParameterServer] = None,
+                 backend: Any = None):
+        self.config = config or ElasticConfig()
+        self.task = dict(task or {"builtin": "sgd_task"})
+        self.task_config = dict(task_config or {})
+        self.plan = fault_plan
+        # The commit authority. Used in-process (no HTTP/socket hop): the
+        # pool IS the driver, and what we need from the server is its
+        # versioned, attempt-fenced apply — the same code path the async
+        # host fits trust.
+        self.ps = server or BaseParameterServer(
+            [np.asarray(w) for w in weights], mode="asynchronous",
+            name="elastic",
+        )
+        self.membership_trace: List[Tuple[str, str]] = []
+        self.registry = HeartbeatRegistry(
+            lease_s=self.config.lease_s, on_event=self._on_event,
+        )
+        if backend is not None:
+            self.backend = backend
+        elif self.config.backend == "jax":
+            self.backend = JaxPodBackend(
+                self.config.coordinator_address or "127.0.0.1:8476"
+            )
+        else:
+            self.backend = EmulationBackend(
+                devices_per_host=self.config.devices_per_host,
+                python=self.config.python,
+                quiet=self.config.quiet_workers,
+            )
+        self.commit_log: List[Dict[str, Any]] = []
+        self.mesh_history: List[Dict[str, Any]] = []
+        self.history: Dict[str, List[float]] = {"loss": []}
+        self.stats: Dict[str, int] = {
+            "rounds_committed": 0, "reformations": 0, "rejected_stale": 0,
+            "discarded_reformation": 0, "kills": 0, "partitions": 0,
+        }
+        self.address: Optional[str] = None
+        self._lock = threading.Lock()
+        self._queue: "queue.Queue" = queue.Queue()
+        self._conns: Dict[int, socket.socket] = {}
+        self._devices: Dict[int, int] = {}
+        self._pending_hello: Dict[int, Dict[str, Any]] = {}
+        self._unadmitted: Set[int] = set()
+        self._spawned_at: Dict[int, int] = {}
+        self._partitioned: Set[int] = set()
+        self._withheld: List[Dict[str, Any]] = []
+        self._state: Optional[_RoundState] = None
+        self._next_host_id = 0
+        self._listener: Optional[socket.socket] = None
+
+    # -- event capture ----------------------------------------------------
+    def _on_event(self, ev: MembershipEvent) -> None:
+        if ev.kind in ("join", "rejoin", "leave", "expire"):
+            self.membership_trace.append((ev.kind, ev.member))
+
+    # -- transport --------------------------------------------------------
+    def _start_listener(self) -> None:
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind((self.config.bind_host, 0))
+        srv.listen(64)
+        self._listener = srv
+        self.address = f"{self.config.bind_host}:{srv.getsockname()[1]}"
+        threading.Thread(target=self._accept_loop, daemon=True,
+                         name="elastic-accept").start()
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed: shutdown
+            threading.Thread(target=self._reader, args=(conn,), daemon=True,
+                             name="elastic-reader").start()
+
+    def _reader(self, conn: socket.socket) -> None:
+        """Per-connection reader: parse frames, enqueue — never decide.
+
+        All policy (liveness, epochs, admission) lives on the main loop, so
+        two hosts' messages can race on the wire without ever racing a
+        registry mutation."""
+        host = None
+        buf = socket_utils.ReusableBuffer()
+        try:
+            hello = socket_utils.receive(conn)
+            if not isinstance(hello, dict) or hello.get("op") != "hello":
+                conn.close()
+                return
+            host = int(hello["host"])
+            with self._lock:
+                self._conns[host] = conn
+            self._queue.put(("hello", host, hello))
+            while True:
+                msg = socket_utils.receive(conn, buf)
+                self._queue.put((msg.get("op"), host, msg))
+        except (ConnectionError, EOFError, OSError):
+            if host is not None:
+                self._queue.put(("eof", host, None))
+
+    def _send(self, host_id: int, msg: Dict[str, Any]) -> bool:
+        with self._lock:
+            conn = self._conns.get(host_id)
+        if conn is None:
+            return False
+        try:
+            socket_utils.send(conn, msg)
+            return True
+        except OSError:
+            return False
+
+    # -- control-queue processing (main loop only) ------------------------
+    def _drain(self, timeout: float) -> None:
+        """Process at most one control message (plus whatever is already
+        queued behind it, without blocking again)."""
+        try:
+            item = self._queue.get(timeout=timeout)
+        except queue.Empty:
+            return
+        while True:
+            self._process(item)
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                return
+
+    def _process(self, item: Tuple[str, int, Any]) -> None:
+        op, host, msg = item
+        member = host_member(host)
+        if op == "hello":
+            self._pending_hello[host] = msg
+            self._devices[host] = max(1, int(msg.get("devices", 1)))
+        elif op == "beat":
+            # A partitioned host's beats are dropped HERE — the channel is
+            # cut at the driver, the worker is healthy and keeps computing:
+            # the textbook zombie. An expired member's beat is ignored too
+            # (heartbeat() would implicitly re-admit it mid-round otherwise;
+            # re-admission is an explicit join at a round boundary).
+            if host not in self._partitioned and self.registry.is_live(member):
+                self.registry.heartbeat(member)
+        elif op == "contrib":
+            self._handle_contrib(host, msg)
+        elif op == "eof":
+            with self._lock:
+                self._conns.pop(host, None)
+            if self.registry.is_live(member):
+                self.registry.expire(member)
+        elif op == "goodbye":
+            pass  # graceful exit after a retire; eof follows
+
+    def _handle_contrib(self, host: int, msg: Dict[str, Any]) -> None:
+        member = host_member(host)
+        epoch = int(msg["epoch"])
+        state = self._state
+        if (state is not None and epoch == state.epoch
+                and int(msg["round"]) == state.round
+                and host in state.expected):
+            if host in self._partitioned:
+                # The zombie's delta reached the driver but its heartbeat
+                # channel is cut: hold it. Once the lease expires and the
+                # round re-forms, the flush path below pushes it through the
+                # server fence — where it is REJECTED, deterministically,
+                # whether it arrived before or after the expiry.
+                self._withheld.append(msg)
+                return
+            if host not in state.contribs:
+                state.contribs[host] = msg
+            return
+        # Stale: stamped with an epoch this round no longer runs under.
+        if self.registry.is_live(member):
+            # A survivor's pre-re-formation delta: valid work, wrong epoch.
+            # Discard at the pool — it must not consume a server version.
+            self.stats["discarded_reformation"] += 1
+        else:
+            self._reject_stale(member, msg)
+
+    def _reject_stale(self, member: str, msg: Dict[str, Any]) -> None:
+        """Push a fenced contribution through the REAL server fence.
+
+        Deliberately not a silent drop: the guarantee under test is that the
+        server refuses it, so the pool applies it exactly as a confused
+        client would and then *verifies* the version did not move."""
+        before = self.ps.version
+        self.ps.apply_delta(msg["delta"], task_id=round_task_id(msg["round"]),
+                            attempt=int(msg["epoch"]))
+        if self.ps.version != before:
+            raise RuntimeError(
+                f"monotonicity violation: stale contribution from {member} "
+                f"(epoch {msg['epoch']}, round {msg['round']}) was applied"
+            )
+        self.stats["rejected_stale"] += 1
+        self.registry.observe_late_reject(member,
+                                          launch_epoch=int(msg["epoch"]))
+
+    # -- membership / scaling (round boundaries) --------------------------
+    def _live_ids(self) -> List[int]:
+        return sorted(
+            int(m.rsplit("-", 1)[1]) for m in self.registry.live()
+        )
+
+    def _spawn(self, host_id: int, at_round: int) -> None:
+        self._spawned_at[host_id] = int(at_round)
+        self._unadmitted.add(host_id)
+        self.backend.spawn(host_id, self.address)
+
+    def _join_delay(self, host_id: int) -> int:
+        if self.plan is None or not hasattr(self.plan, "join_delay"):
+            return 0
+        return int(self.plan.join_delay(host_id))
+
+    def _await_hellos(self, hosts: List[int]) -> None:
+        deadline = time.monotonic() + self.config.join_timeout_s
+        missing = [h for h in hosts if h not in self._pending_hello]
+        while missing:
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"hosts {missing} never connected to the control plane "
+                    f"at {self.address} within "
+                    f"{self.config.join_timeout_s:.1f}s"
+                )
+            self._drain(timeout=0.05)
+            missing = [h for h in hosts if h not in self._pending_hello]
+
+    def _admit_pending(self, round_index: int) -> None:
+        """Admit every DUE host, in host-id order, at a round boundary —
+        never mid-round, so an issued round's membership only ever shrinks.
+
+        Due = spawned, and its admission delay (if the fault plan imposes
+        one) has elapsed. Admission blocks on a due host's hello rather
+        than racing its boot: whichever boundary a host becomes due at is
+        the boundary it joins at, deterministically."""
+        due = sorted(
+            h for h in self._unadmitted
+            if round_index - self._spawned_at.get(h, round_index)
+            >= self._join_delay(h)
+        )
+        self._await_hellos(due)
+        for host in due:
+            hello = self._pending_hello.pop(host)
+            self._unadmitted.discard(host)
+            self.registry.join(host_member(host))
+            self._send(host, {
+                "op": "adopt",
+                "task": self.task,
+                "config": self.task_config,
+                "beat_interval_s": self.config.beat_interval_s,
+                "devices": int(hello.get("devices", 1)),
+            })
+
+    def _retire(self, host_id: int) -> None:
+        """Graceful scale-down: tell the worker to stop, fence its future."""
+        self._send(host_id, {"op": "stop"})
+        self.registry.leave(host_member(host_id))
+
+    def _apply_scale(self, round_index: int) -> None:
+        target = self.config.scale_schedule.get(round_index)
+        if target is None:
+            return
+        live = self._live_ids()
+        planned = len(live) + len(self._unadmitted)
+        while planned < target:
+            host = self._next_host_id
+            self._next_host_id += 1
+            self._spawn(host, round_index)
+            planned += 1
+        if target < len(live):
+            for host in sorted(live, reverse=True)[: len(live) - target]:
+                self._retire(host)
+        # _admit_pending (called right after) blocks on due hellos, so a
+        # non-delayed spawn joins THIS boundary; a delayed one misses it.
+
+    def _record_mesh(self, epoch: int, live: List[int],
+                     round_index: int) -> None:
+        spec = {
+            "epoch": int(epoch),
+            "round": int(round_index),
+            "hosts": [(h, self._devices.get(h, 1)) for h in live],
+            "num_hosts": len(live),
+            "total_devices": sum(self._devices.get(h, 1) for h in live),
+        }
+        if not self.mesh_history or (
+            self.mesh_history[-1]["hosts"] != spec["hosts"]
+        ):
+            self.mesh_history.append(spec)
+
+    # -- data -------------------------------------------------------------
+    def _shard(self, x: np.ndarray, y: np.ndarray,
+               live: List[int]) -> Dict[int, Tuple[np.ndarray, np.ndarray]]:
+        """Recut the global batch over the CURRENT formation, weighted by
+        device count — the data-parallel analogue of the mesh re-forming."""
+        devices = [self._devices.get(h, 1) for h in live]
+        total = sum(devices)
+        n = int(x.shape[0])
+        cuts, acc = [], 0
+        for d in devices[:-1]:
+            acc += d
+            cuts.append(int(round(n * acc / total)))
+        xs = np.split(x, cuts)
+        ys = np.split(y, cuts)
+        return {h: (xs[i], ys[i]) for i, h in enumerate(live)}
+
+    @staticmethod
+    def _merge(contribs: List[Dict[str, Any]]) -> List[np.ndarray]:
+        """Sample-weighted mean of the round's deltas (the proxy-collective
+        reduce: what an allreduce over the formation would have computed)."""
+        weights = [max(1, int(c.get("metrics", {}).get("samples", 1)))
+                   for c in contribs]
+        total = float(sum(weights))
+        merged = None
+        for w, c in zip(weights, contribs):
+            scaled = [np.asarray(d) * (w / total) for d in c["delta"]]
+            merged = scaled if merged is None else [
+                m + s for m, s in zip(merged, scaled)
+            ]
+        return merged
+
+    # -- the fit loop -----------------------------------------------------
+    def fit(self, x: np.ndarray, y: np.ndarray,
+            rounds: Optional[int] = None) -> List[np.ndarray]:
+        """Run ``rounds`` elastic rounds over ``(x, y)``; returns the final
+        committed weights. Membership changes (scheduled scale-ups, fault-
+        plan kills/partitions, delayed joins) are absorbed mid-fit."""
+        cfg = self.config
+        rounds = cfg.rounds if rounds is None else int(rounds)
+        x = np.asarray(x)
+        y = np.asarray(y)
+        self._start_listener()
+        try:
+            for host in range(cfg.initial_hosts):
+                self._next_host_id = host + 1
+                self._spawn(host, at_round=0)
+            for r in range(rounds):
+                self._apply_scale(r)
+                self._admit_pending(r)
+                self._run_round(r, x, y)
+            return [np.array(w) for w in self.ps.weights]
+        finally:
+            self.close()
+
+    def _run_round(self, r: int, x: np.ndarray, y: np.ndarray) -> None:
+        cfg = self.config
+        kill = (self.plan.host_kill(r)
+                if self.plan is not None and hasattr(self.plan, "host_kill")
+                else None)
+        part = (self.plan.host_partition(r)
+                if self.plan is not None
+                and hasattr(self.plan, "host_partition") else None)
+        if part is not None and part in self._live_ids():
+            self._partitioned.add(part)
+            self.stats["partitions"] += 1
+        task_id = round_task_id(r)
+        while True:  # re-issue loop: one iteration per formation
+            live = self._live_ids()
+            if len(live) < cfg.min_hosts:
+                raise RuntimeError(
+                    f"round {r}: only {len(live)} live hosts "
+                    f"(min_hosts={cfg.min_hosts}); formation cannot continue"
+                )
+            epoch = self.registry.epoch
+            # The commit authority learns the new formation FIRST: any
+            # contribution stamped with an older epoch is now fenced, even
+            # if it beats this issue's own commit to the server.
+            self.ps.register_attempt(task_id, epoch)
+            self._record_mesh(epoch, live, r)
+            state = _RoundState(epoch, r, set(live))
+            self._state = state
+            shards = self._shard(x, y, live)
+            weights = [np.asarray(w) for w in self.ps.weights]
+            version = self.ps.version
+            issued = True
+            for host in live:
+                if not self._send(host, {
+                    "op": "round", "epoch": epoch, "round": r,
+                    "version": version, "weights": weights,
+                    "shard": shards[host],
+                }):
+                    self.registry.expire(host_member(host))
+                    issued = False
+                    break
+            if not issued:
+                self._state = None
+                self.stats["reformations"] += 1
+                continue
+            if kill is not None and kill in live:
+                # Mid-round host death: the round is issued, the victim is
+                # computing (or about to) — SIGKILL, for real.
+                self.backend.kill(kill)
+                self.stats["kills"] += 1
+                kill = None  # at-most-once (FaultPlan already marked fired)
+            reform = False
+            deadline = time.monotonic() + cfg.round_timeout_s
+            while True:
+                self.registry.sweep()
+                live_now = set(self._live_ids())
+                if state.expected - live_now:
+                    reform = True  # an expected host died: re-form
+                    break
+                if live_now and live_now <= set(state.contribs):
+                    break          # every live expected host reported
+                if time.monotonic() > deadline:
+                    for host in sorted(live_now - set(state.contribs)):
+                        self.registry.expire(host_member(host))
+                    reform = True
+                    break
+                self._drain(timeout=min(cfg.beat_interval_s, 0.05))
+            self._state = None
+            if reform:
+                # Contributions already in hand were computed under the old
+                # formation: discard (stragglers still in flight are caught
+                # by the epoch check on arrival).
+                self.stats["discarded_reformation"] += len(state.contribs)
+                self.stats["reformations"] += 1
+                continue
+            self._commit(state, task_id)
+            return
+
+    def _commit(self, state: _RoundState, task_id: str) -> None:
+        ordered = [state.contribs[h] for h in sorted(state.contribs)]
+        merged = self._merge(ordered)
+        before = self.ps.version
+        self.ps.apply_delta(merged, task_id=task_id, attempt=state.epoch)
+        if self.ps.version != before + 1:
+            raise RuntimeError(
+                f"monotonicity violation: committing round {state.round} at "
+                f"epoch {state.epoch} moved the version {before} -> "
+                f"{self.ps.version} (expected exactly +1)"
+            )
+        self.ps.commit_attempt(task_id)  # drop the accumulator, KEEP the fence
+        losses = [float(c["metrics"].get("loss", float("nan")))
+                  for c in ordered]
+        samples = [max(1, int(c["metrics"].get("samples", 1)))
+                   for c in ordered]
+        loss = float(np.average(losses, weights=samples))
+        self.history["loss"].append(loss)
+        self.commit_log.append({
+            "version": int(self.ps.version),
+            "epoch": int(state.epoch),
+            "round": int(state.round),
+            "contributors": sorted(state.contribs),
+            "loss": loss,
+            # Same clock as the registry's event `at` stamps: the elasticity
+            # bench reads time-to-recover (expire -> next commit) off the
+            # two logs directly.
+            "at": self.registry.clock(),
+        })
+        self.stats["rounds_committed"] += 1
+        self.registry.observe_round(expected=len(state.expected),
+                                    received=len(state.contribs))
+        # Flush withheld zombie deltas through the server fence now that the
+        # round committed under the post-re-formation epoch: each MUST be
+        # rejected (verified inside _reject_stale).
+        withheld, self._withheld = self._withheld, []
+        for msg in withheld:
+            self._reject_stale(host_member(int(msg["host"])), msg)
+
+    # -- lifecycle / observability ----------------------------------------
+    def close(self) -> None:
+        with self._lock:
+            conns = dict(self._conns)
+        for host in sorted(conns):
+            self._send(host, {"op": "stop"})
+        if hasattr(self.backend, "stop_all"):
+            self.backend.stop_all()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            self._listener = None
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able control-plane state, ``serving/metrics.py`` style."""
+        return {
+            "address": self.address,
+            "stats": dict(self.stats),
+            "commit_log": [dict(c) for c in self.commit_log],
+            "mesh_history": [dict(m) for m in self.mesh_history],
+            "membership_trace": [list(t) for t in self.membership_trace],
+            "parameter_server": {
+                "version": int(self.ps.version),
+                "rejected_stale": int(self.ps.rejected_stale),
+            },
+            "registry": self.registry.snapshot(),
+        }

@@ -31,6 +31,13 @@ Injection points, one per layer the tentpole names:
 - **serving steps** — :meth:`FaultPlan.serving_stall` injects deterministic
   wall-clock stalls by engine step index; the ``ServingEngine`` adds them
   to its clock reading, pushing slow requests past their deadlines.
+- **host processes** — :meth:`FaultPlan.host_kill` /
+  :meth:`FaultPlan.host_partition` / :meth:`FaultPlan.join_delay` drive the
+  elastic control plane (``parallel/elastic.py``): a real ``SIGKILL`` of a
+  host process mid-round, a one-sided heartbeat-channel partition (the
+  zombie keeps computing; its delta must be fenced), and a deferred
+  admission of a freshly spawned host (late join). All exact round→host
+  maps, so membership-event traces pin at fixed seed.
 
 Faults fire AT MOST ONCE per crash site (``fired``/``crash_fired``
 bookkeeping), so retries and supervisor restarts proceed — the injected
@@ -93,6 +100,9 @@ class FaultPlan:
                  server_drop_push: float = 0.0,
                  server_pull_delay_s: float = 0.0,
                  serving_stalls: Optional[Dict[int, float]] = None,
+                 kill_hosts: Optional[Dict[int, int]] = None,
+                 partition_hosts: Optional[Dict[int, int]] = None,
+                 join_delay_rounds: Optional[Dict[int, int]] = None,
                  sleep: Callable[[float], None] = time.sleep):
         self.seed = int(seed)
         self.drop_push = float(drop_push)
@@ -119,6 +129,21 @@ class FaultPlan:
         self.server_drop_push = float(server_drop_push)
         self.server_pull_delay_s = float(server_pull_delay_s)
         self.serving_stalls = dict(serving_stalls or {})
+        # Host-level crash sites for the elastic control plane
+        # (parallel/elastic.py) — exact round→host maps, like crash_sites:
+        # kill_hosts SIGKILLs a host PROCESS mid-round; partition_hosts cuts
+        # a host's heartbeat channel (the worker stays alive and keeps
+        # computing — a zombie whose delta must be fenced); join_delay_rounds
+        # is host→rounds a spawned host's admission is deferred (late join).
+        self.kill_hosts = {
+            int(r): int(h) for r, h in (kill_hosts or {}).items()
+        }
+        self.partition_hosts = {
+            int(r): int(h) for r, h in (partition_hosts or {}).items()
+        }
+        self.join_delay_rounds = {
+            int(h): int(d) for h, d in (join_delay_rounds or {}).items()
+        }
         self.sleep = sleep
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {}
@@ -246,6 +271,45 @@ class FaultPlan:
         raise InjectedWorkerCrash(
             f"injected crash at {site!r} call {n}"
         )
+
+    # -- host-level sites (elastic control plane) ------------------------
+    def host_kill(self, round_index: int) -> Optional[int]:
+        """Host id to SIGKILL at round ``round_index`` (at most once per
+        host site), or None. The elastic pool consults this right after
+        issuing the round — the death is mid-round by construction."""
+        host = self.kill_hosts.get(int(round_index))
+        if host is None:
+            return None
+        site = f"kill-host-{host}"
+        with self._lock:
+            if site in self.fired:
+                return None
+            self.fired[site] = int(round_index)
+        return int(host)
+
+    def host_partition(self, round_index: int) -> Optional[int]:
+        """Host whose heartbeat channel is cut starting at ``round_index``
+        (at most once per host site), or None. The partition is one-sided
+        and permanent: the host keeps computing and sending, the control
+        plane stops hearing it — lease expiry does the rest."""
+        host = self.partition_hosts.get(int(round_index))
+        if host is None:
+            return None
+        site = f"partition-host-{host}"
+        with self._lock:
+            if site in self.fired:
+                return None
+            self.fired[site] = int(round_index)
+        return int(host)
+
+    def join_delay(self, host_id: int) -> int:
+        """Rounds to defer admission of a freshly spawned ``host_id``."""
+        delay = int(self.join_delay_rounds.get(int(host_id), 0))
+        if delay > 0:
+            with self._lock:
+                self.fired.setdefault(f"delay-join-host-{int(host_id)}",
+                                      delay)
+        return delay
 
     # -- server-side hooks -----------------------------------------------
     def drop_server_push(self) -> bool:

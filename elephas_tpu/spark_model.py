@@ -79,6 +79,7 @@ class SparkModel:
                  round_deadline_s: Optional[float] = None,
                  backup_stragglers: bool = True,
                  hot_standby: bool = False,
+                 elastic=None,
                  *args, **kwargs):
         if mode not in ("synchronous", "asynchronous", "hogwild"):
             raise ValueError(f"Unknown mode: {mode}")
@@ -166,6 +167,13 @@ class SparkModel:
                     "hot_standby supports the http/socket parameter servers "
                     f"(got {parameter_server_mode!r})"
                 )
+        # Elastic HOST training (elephas_tpu.parallel.elastic): an
+        # ElasticConfig routes fit through a pool of real worker processes
+        # leasing membership from the driver — hosts may join, leave, and
+        # die mid-fit; the mesh re-forms per membership epoch. Orthogonal to
+        # `membership`, which governs thread-level partitions of one host.
+        self.elastic = elastic
+        self._elastic_pool = None
         self._standby_server = None
         self._ps_stats: Dict[str, Any] = {}
         self._fit_kwargs: Dict[str, Any] = {}
@@ -269,7 +277,9 @@ class SparkModel:
 
     def _fit(self, rdd: RDD, epochs: int, batch_size: int, verbose: int,
              validation_split: float) -> None:
-        if self.comm == "jax":
+        if self.elastic is not None:
+            self._fit_elastic(rdd, epochs, batch_size, verbose)
+        elif self.comm == "jax":
             self._fit_jax(rdd, epochs, batch_size, verbose, validation_split)
         elif self.mode == "synchronous":
             self._fit_host_sync(rdd, epochs, batch_size, verbose, validation_split)
@@ -493,6 +503,53 @@ class SparkModel:
             ]
         model.set_weights(new_parameters)
 
+    # -- elastic host path: driver as control plane over host processes --
+    def _fit_elastic(self, rdd, epochs, batch_size, verbose) -> None:
+        """Train over an elastic pool of real host processes.
+
+        One elastic round = one global pass over the densified data: the
+        driver recuts the batch over the CURRENT host formation each round
+        (the mesh re-forms as hosts join/leave/die), every host runs one
+        local ``model.fit`` epoch on its shard, and the sample-weighted
+        merged delta commits through the versioned, epoch-fenced parameter
+        store. ``epochs`` maps to rounds; ``validation_split`` is a
+        driver-side concern the elastic path does not consume (workers see
+        training shards only).
+        """
+        from .parallel.elastic import ElasticHostPool
+
+        model = self._master_network
+        blocks = self._partition_blocks(rdd, batch_size)
+        if not blocks:
+            raise ValueError(
+                "All partitions were skipped (each needs > batch_size samples)"
+            )
+        x = np.concatenate([b[0] for b in blocks])
+        y = np.concatenate([b[1] for b in blocks])
+        task_config = {
+            "model_json": model.to_json(),
+            "optimizer": self.master_optimizer,
+            "loss": self.master_loss,
+            "metrics": self.master_metrics or [],
+            "local_epochs": 1,
+            "batch_size": batch_size,
+        }
+        pool = ElasticHostPool(
+            model.get_weights(), self.elastic,
+            task={"builtin": "keras_fit_task"},
+            task_config=task_config,
+            fault_plan=self.fault_plan,
+        )
+        self._elastic_pool = pool
+        weights = pool.fit(x, y, rounds=epochs)
+        model.set_weights(weights)
+        self.training_histories.append({
+            "mode": "elastic",
+            "loss": list(pool.history["loss"]),
+            "rounds_committed": int(pool.stats["rounds_committed"]),
+            "reformations": int(pool.stats["reformations"]),
+        })
+
     # -- host path: reference-shaped async/hogwild against a live PS -----
     def start_server(self) -> None:
         weights = self._master_network.get_weights()
@@ -615,6 +672,11 @@ class SparkModel:
         if self.membership is not None:
             snap = self.membership.snapshot()
         snap["parameter_servers"] = dict(self._ps_stats)
+        if self._elastic_pool is not None:
+            # Host-level control plane: epochs/commits/mesh formations from
+            # the last elastic fit (the thread-level registry above tracks
+            # partitions; this tracks whole hosts).
+            snap["elastic"] = self._elastic_pool.snapshot()
         return snap
 
     def _fit_host_async(self, rdd, epochs, batch_size, verbose, validation_split):

@@ -19,6 +19,7 @@ from __future__ import annotations
 import os
 import pickle
 import socket
+import time
 from typing import Any
 
 #: Fixed width of the ASCII length header (reference uses a fixed-width
@@ -40,6 +41,57 @@ def determine_master(port: int = 4000) -> str:
     except socket.gaierror:
         host = "127.0.0.1"
     return f"{host}:{port}"
+
+
+def parse_address(address: str, default_port: int = 4000) -> "tuple[str, int]":
+    """``host[:port]`` → ``(host, port)``."""
+    if ":" in address:
+        host, port = address.rsplit(":", 1)
+        return host, int(port)
+    return address, int(default_port)
+
+
+def connect_with_retry(address: str, *, timeout_s: float = 20.0,
+                       base_delay_s: float = 0.05,
+                       connect_timeout_s: float = 2.0,
+                       sleep=time.sleep,
+                       clock=time.monotonic) -> socket.socket:
+    """Dial ``host:port`` with bounded exponential-backoff retries.
+
+    The failure mode this exists for: a worker (or a multi-host JAX process)
+    dialing a coordinator that is still binding, briefly partitioned, or
+    simply gone. A bare ``connect`` either fails instantly (refused while the
+    peer races its ``bind``) or hangs at the OS default (~2 min SYN retries)
+    — both wrong for a control plane that must make a liveness decision.
+    Retries double from ``base_delay_s`` up to 1s between attempts; once
+    ``timeout_s`` elapses a ``RuntimeError`` NAMING THE ADDRESS is raised so
+    the operator knows which endpoint was unreachable.
+    """
+    host, port = parse_address(address)
+    deadline = clock() + float(timeout_s)
+    delay = float(base_delay_s)
+    last_err: Exception | None = None
+    while True:
+        budget = deadline - clock()
+        if budget <= 0:
+            raise RuntimeError(
+                f"could not reach {host}:{port} within {timeout_s:.1f}s "
+                f"(last error: {last_err!r})"
+            )
+        try:
+            sock = socket.create_connection(
+                (host, port), timeout=min(connect_timeout_s, max(budget, 0.01))
+            )
+            # The timeout above bounds the CONNECT only. Left on the socket
+            # it would poison every later blocking recv (a worker idling at
+            # a round boundary longer than connect_timeout_s would see a
+            # spurious TimeoutError and tear itself down).
+            sock.settimeout(None)
+            return sock
+        except OSError as err:
+            last_err = err
+            sleep(min(delay, max(deadline - clock(), 0.0)))
+            delay = min(delay * 2.0, 1.0)
 
 
 class ReusableBuffer:

@@ -45,6 +45,19 @@ def task_id_for(ctx) -> str:
     return f"stage-{ctx.stageId()}-partition-{ctx.partitionId()}"
 
 
+def round_task_id(round_index: int) -> str:
+    """Parameter-server task id for one elastic host round
+    (:class:`~elephas_tpu.parallel.elastic.ElasticHostPool`).
+
+    Round-scoped rather than partition-scoped: the elastic pool commits ONE
+    merged delta per round, tagged with the membership epoch as its attempt
+    number, so the server's attempt fence — the same machinery that rejects
+    zombie partition retries above — rejects any contribution launched under
+    a pre-re-formation epoch. One format, shared with the tests.
+    """
+    return f"round-{int(round_index)}"
+
+
 def _materialize(data_iterator: Iterator) -> Optional[tuple]:
     """Partition iterator of ``(x, y)`` pairs → dense ``(x, y)`` arrays."""
     xs, ys = [], []

@@ -23,8 +23,9 @@
 #
 # Marker groups: ELEPHAS_TEST_GROUP=<marker> (e.g. `chaos`, `perf` for
 # the slow train-step parity sweeps, `spec`, `streaming` for the
-# train-to-serve rollover pins, or `fleet` for the serving-fleet
-# control plane — see the matching make targets) restricts
+# train-to-serve rollover pins, `fleet` for the serving-fleet
+# control plane, or `elastic` for the elastic multi-host pins with
+# subprocess host emulation — see the matching make targets) restricts
 # every shard to that pytest marker. The group's `-m` is appended AFTER the
 # caller's args because pytest honors only the LAST -m — so
 # `ELEPHAS_TEST_GROUP=chaos make test-fast` runs the chaos group even

@@ -1,0 +1,131 @@
+"""Host-level chaos against the elastic control plane — pinned, not fuzzed.
+
+Each scenario scripts its faults through the seeded FaultPlan's exact
+round→host maps (`kill_hosts` / `partition_hosts` / `join_delay_rounds`),
+so the membership-event trace, the commit log, and every fencing counter
+are asserted as literals. The kills are real SIGKILLs of real processes;
+the partitions cut a real heartbeat channel while the worker keeps
+computing.
+
+The sgd tasks carry a small ``sleep_s`` so a killed host is guaranteed to
+die MID-compute (the signal always lands faster than the task finishes) —
+that is what makes the re-formation path, not the lucky-commit path,
+deterministic.
+"""
+
+import numpy as np
+import pytest
+
+from elephas_tpu.parallel.elastic import ElasticConfig, ElasticHostPool
+from elephas_tpu.resilience.faults import FaultPlan
+
+pytestmark = [pytest.mark.elastic, pytest.mark.chaos]
+
+
+def _lsq_problem(seed=0, n=300, d=3):
+    rng = np.random.default_rng(seed)
+    w_true = np.array([1.0, -2.0, 3.0])[:d]
+    x = rng.normal(size=(n, d))
+    return x, x @ w_true, w_true
+
+
+def _pool(cfg, plan, sleep_s=0.3):
+    x, y, _ = _lsq_problem()
+    pool = ElasticHostPool(
+        [np.zeros(3)], cfg, task={"builtin": "sgd_task"},
+        task_config={"lr": 0.5, "sleep_s": sleep_s}, fault_plan=plan,
+    )
+    return pool, pool.fit(x, y)
+
+
+def test_kill_host_mid_round_reforms():
+    cfg = ElasticConfig(initial_hosts=3, rounds=4, lease_s=2.0,
+                        beat_interval_s=0.1)
+    plan = FaultPlan(seed=11, kill_hosts={1: 2})
+    pool, _ = _pool(cfg, plan)
+    assert plan.fired.get("kill-host-2") == 1
+    assert pool.stats["kills"] == 1
+    assert pool.stats["reformations"] == 1
+    assert pool.membership_trace == [
+        ("join", "host-0"), ("join", "host-1"), ("join", "host-2"),
+        ("expire", "host-2"),
+    ]
+    # round 1 re-forms over the survivors and still commits; versions never
+    # skip or repeat — the killed issue consumed no version
+    assert [(c["version"], c["round"], c["contributors"])
+            for c in pool.commit_log] == [
+        (1, 0, [0, 1, 2]), (2, 1, [0, 1]), (3, 2, [0, 1]), (4, 3, [0, 1]),
+    ]
+    # the survivors' pre-re-formation deltas were discarded at the pool,
+    # never consuming a server version
+    assert pool.stats["discarded_reformation"] == 2
+    assert pool.ps.rejected_stale == 0
+
+
+def test_zombie_partition_delta_rejected_stale():
+    """Heartbeat-channel partition: the host stays alive and computes, the
+    control plane stops hearing it. Its lease lapses, the round re-forms,
+    and its delta — pushed through the REAL server fence — lands in
+    ``rejected_stale``, not the weights."""
+    cfg = ElasticConfig(initial_hosts=3, rounds=3, lease_s=1.5,
+                        beat_interval_s=0.1)
+    plan = FaultPlan(seed=3, partition_hosts={1: 2})
+    pool, _ = _pool(cfg, plan, sleep_s=0.1)
+    assert plan.fired.get("partition-host-2") == 1
+    assert pool.stats["partitions"] == 1
+    assert pool.membership_trace == [
+        ("join", "host-0"), ("join", "host-1"), ("join", "host-2"),
+        ("expire", "host-2"),
+    ]
+    # exactly one zombie delta, rejected BY THE SERVER (version untouched)
+    assert pool.ps.rejected_stale == 1
+    assert pool.stats["rejected_stale"] == 1
+    assert pool.ps.version == len(pool.commit_log) == 3
+    assert [c["version"] for c in pool.commit_log] == [1, 2, 3]
+    events = pool.registry.snapshot()["events"]
+    rejects = [e for e in events if e["kind"] == "late_reject"]
+    assert len(rejects) == 1 and rejects[0]["member"] == "host-2"
+
+
+def test_delayed_join_misses_boundaries_then_joins():
+    cfg = ElasticConfig(initial_hosts=2, rounds=4, lease_s=2.0,
+                        beat_interval_s=0.1, scale_schedule={1: 3})
+    plan = FaultPlan(seed=5, join_delay_rounds={2: 2})
+    pool, _ = _pool(cfg, plan, sleep_s=0.0)
+    assert plan.fired.get("delay-join-host-2") == 2
+    # spawned at round 1, admitted two boundaries later: contributes from
+    # round 3 on
+    assert [len(c["contributors"]) for c in pool.commit_log] == [2, 2, 2, 3]
+    assert pool.membership_trace == [
+        ("join", "host-0"), ("join", "host-1"), ("join", "host-2"),
+    ]
+
+
+def test_min_hosts_floor_is_enforced():
+    cfg = ElasticConfig(initial_hosts=2, rounds=3, lease_s=1.5,
+                        beat_interval_s=0.1, min_hosts=2)
+    plan = FaultPlan(seed=9, kill_hosts={1: 0})
+    x, y, _ = _lsq_problem()
+    pool = ElasticHostPool(
+        [np.zeros(3)], cfg, task={"builtin": "sgd_task"},
+        task_config={"lr": 0.5, "sleep_s": 0.3}, fault_plan=plan,
+    )
+    with pytest.raises(RuntimeError, match="min_hosts"):
+        pool.fit(x, y)
+
+
+def test_trace_deterministic_across_runs():
+    """Same seed, same faults → identical membership trace and commit shape,
+    run twice for real (fresh processes both times)."""
+    def run_once():
+        cfg = ElasticConfig(initial_hosts=2, rounds=4, lease_s=2.0,
+                            beat_interval_s=0.1, scale_schedule={1: 3})
+        plan = FaultPlan(seed=21, kill_hosts={2: 1})
+        pool, _ = _pool(cfg, plan)
+        return (
+            pool.membership_trace,
+            [(c["version"], c["round"], tuple(c["contributors"]))
+             for c in pool.commit_log],
+        )
+
+    assert run_once() == run_once()

@@ -1,0 +1,154 @@
+"""Cluster bootstrap robustness + backend lifecycle.
+
+`initialize_cluster` used to hand an unreachable coordinator straight to
+``jax.distributed.initialize``, which blocks forever — a mistyped address
+turned a pod bring-up into a silent hang. With ``timeout_s`` it probes the
+endpoint with bounded backoff and raises a ``RuntimeError`` NAMING the
+address (the refused-port pin below). The backend tests cover the process
+half of the emulation harness (spawn/kill/reap — no orphan Popen) and the
+real-pod geometry planner.
+"""
+
+import os
+import socket
+import subprocess
+import time
+
+import pytest
+
+from elephas_tpu.parallel.distributed import initialize_cluster
+from elephas_tpu.parallel.emulation import EmulationBackend, JaxPodBackend
+from elephas_tpu.utils.sockets import connect_with_retry, parse_address
+
+pytestmark = pytest.mark.elastic
+
+
+def _refused_address() -> str:
+    """An address guaranteed-refused RIGHT NOW: bind, read, close — nothing
+    rebinds it within the sub-second probe window of these tests."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    return f"127.0.0.1:{port}"
+
+
+def test_initialize_cluster_refused_port_raises_named_error():
+    address = _refused_address()
+    start = time.monotonic()
+    with pytest.raises(RuntimeError) as err:
+        initialize_cluster(coordinator_address=address, num_processes=2,
+                           process_id=1, timeout_s=1.0)
+    elapsed = time.monotonic() - start
+    assert address in str(err.value)            # names the coordinator
+    assert "could not join the cluster" in str(err.value)
+    assert elapsed < 10.0                       # bounded, not a hang
+
+
+def test_initialize_cluster_single_process_is_noop():
+    # no coordinator, no env: must return immediately without touching
+    # jax.distributed at all
+    assert initialize_cluster(num_processes=1, timeout_s=0.1) is None
+
+
+def test_connect_with_retry_backs_off_then_raises():
+    address = _refused_address()
+    sleeps = []
+    fake_now = {"t": 0.0}
+
+    def fake_sleep(s):
+        sleeps.append(s)
+        fake_now["t"] += s
+
+    with pytest.raises(RuntimeError) as err:
+        connect_with_retry(address, timeout_s=0.5, base_delay_s=0.05,
+                           sleep=fake_sleep,
+                           clock=lambda: fake_now["t"])
+    assert address.split(":")[0] in str(err.value)
+    # exponential: each delay doubles until the 1s cap
+    assert sleeps[:3] == [0.05, 0.1, 0.2]
+
+
+def test_connect_with_retry_reaches_live_listener():
+    with socket.socket() as srv:
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        conn = connect_with_retry(f"127.0.0.1:{srv.getsockname()[1]}",
+                                  timeout_s=5.0)
+        conn.close()
+
+
+def test_parse_address():
+    assert parse_address("10.0.0.1:8476") == ("10.0.0.1", 8476)
+    assert parse_address("10.0.0.1", default_port=4000) == ("10.0.0.1", 4000)
+
+
+def test_emulation_backend_spawns_kills_and_reaps():
+    backend = EmulationBackend(devices_per_host=1)
+    with socket.socket() as srv:
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(4)
+        backend.spawn(0, f"127.0.0.1:{srv.getsockname()[1]}")
+        srv.settimeout(30)
+        peer, _ = srv.accept()            # the worker process really dialed
+        assert backend.alive(0)
+        backend.kill(0)                   # real SIGKILL...
+        assert not backend.alive(0)       # ...and already reaped
+        assert backend.procs[0].returncode == -9
+        peer.close()
+    backend.stop_all()
+    # no orphan Popen: every spawned process has a collected return code
+    assert all(p.returncode is not None for p in backend.procs.values())
+
+
+def test_emulation_backend_stop_all_reaps_stragglers():
+    backend = EmulationBackend(devices_per_host=1)
+    # never accepts: the worker sits in its connect-retry loop
+    with socket.socket() as srv:
+        srv.bind(("127.0.0.1", 0))
+        backend.spawn(0, f"127.0.0.1:{srv.getsockname()[1]}")
+        assert backend.alive(0)
+        backend.stop_all(grace_s=0.2)     # grace expires -> SIGKILL + wait
+    assert backend.procs[0].returncode is not None
+
+
+def test_jax_pod_backend_reform_renumbers_densely():
+    backend = JaxPodBackend("10.0.0.1:8476", timeout_s=30.0)
+    plan = backend.reform([4, 0, 7])
+    # jax.distributed needs process ids in [0, num_processes): survivors are
+    # renumbered densely, lowest survivor hosts the restarted coordinator
+    assert plan == {
+        "coordinator_host": 0,
+        "num_processes": 3,
+        "process_ids": {0: 0, 4: 1, 7: 2},
+    }
+    boot = backend.bootstrap(host_id=4, num_processes=3)
+    assert boot["coordinator_address"] == "10.0.0.1:8476"
+    assert boot["process_id"] == 4 and boot["timeout_s"] == 30.0
+
+
+def test_worker_script_runs_standalone_without_package_import():
+    """The emulation worker must boot WITHOUT importing elephas_tpu (the
+    package __init__ pulls in keras — seconds per host). Run it with the
+    package unimportable and a driver that immediately closes: the worker
+    must exit cleanly via its connection-lost path, not an ImportError."""
+    import elephas_tpu.parallel.emulation as emulation
+
+    with socket.socket() as srv:
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+        env["PYTHONPATH"] = "/nonexistent"
+        proc = subprocess.Popen(
+            ["python3", emulation.__file__,
+             "--driver", f"127.0.0.1:{srv.getsockname()[1]}",
+             "--host-id", "0"],
+            env=env, stderr=subprocess.PIPE, text=True,
+        )
+        peer, _ = srv.accept()
+        peer.close()                      # driver vanishes mid-handshake
+        _, stderr = proc.communicate(timeout=60)
+    assert proc.returncode == 1, stderr[-2000:]
+    assert "ImportError" not in stderr and "ModuleNotFoundError" not in stderr

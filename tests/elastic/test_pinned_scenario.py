@@ -1,0 +1,88 @@
+"""THE pinned elastic chaos scenario (ROADMAP acceptance):
+
+an elastic 2→4→3-host ``SparkModel.fit`` — real Keras replicas in real host
+processes — that scales up mid-fit (one of the new hosts joining LATE),
+loses a host to a real SIGKILL mid-round, re-forms the mesh each time, and
+still converges; with the membership-event trace and the committed-version
+log deterministic at the fixed seed and the committed-update monotonicity
+asserted straight off the parameter store's version log.
+"""
+
+import numpy as np
+import pytest
+
+from elephas_tpu import SparkModel
+from elephas_tpu.parallel.elastic import ElasticConfig
+from elephas_tpu.resilience.faults import FaultPlan
+from elephas_tpu.utils.rdd_utils import to_simple_rdd
+
+from ..conftest import make_classifier
+
+pytestmark = [pytest.mark.elastic, pytest.mark.chaos]
+
+ROUNDS = 6
+
+# The full expected membership-event sequence, as literals: hosts 0-1 boot
+# the fit; the round-2 scale-up to 4 spawns hosts 2-3 but host 3's admission
+# is delayed one boundary (late join); host 1 is SIGKILLed mid-round 4.
+EXPECTED_TRACE = [
+    ("join", "host-0"),
+    ("join", "host-1"),
+    ("join", "host-2"),
+    ("join", "host-3"),
+    ("expire", "host-1"),
+]
+
+
+@pytest.mark.timeout(280)
+def test_elastic_2_4_3_spark_fit(spark_context, toy_classification):
+    x, y = toy_classification
+    rdd = to_simple_rdd(spark_context, x, y)
+    model = make_classifier(hidden=8, optimizer="sgd")
+    plan = FaultPlan(seed=1234, kill_hosts={4: 1}, join_delay_rounds={3: 1})
+    sm = SparkModel(
+        model, num_workers=4, batch_size=32,
+        fault_plan=plan,
+        elastic=ElasticConfig(
+            initial_hosts=2, scale_schedule={2: 4}, min_hosts=1,
+            lease_s=4.0, beat_interval_s=0.2, round_timeout_s=180.0,
+        ),
+    )
+    sm.fit(rdd, epochs=ROUNDS, batch_size=32, validation_split=0.0)
+    pool = sm._elastic_pool
+
+    # -- convergence through the chaos -----------------------------------
+    losses = pool.history["loss"]
+    assert len(losses) == ROUNDS
+    assert losses[-1] < losses[0], losses
+
+    # -- membership-event trace: deterministic at the fixed seed ----------
+    assert pool.membership_trace == EXPECTED_TRACE
+    assert plan.fired.get("kill-host-1") == 4
+    assert plan.fired.get("delay-join-host-3") == 1
+
+    # -- the mesh re-formed 2 → 3 → 4 → 3 (host 3 joined a boundary after
+    #    hosts 2; host 1 died) — device count changed mid-fit -------------
+    assert [m["num_hosts"] for m in pool.mesh_history] == [2, 3, 4, 3]
+
+    # -- committed-update monotonicity, straight off the PS version log --
+    versions = [c["version"] for c in pool.commit_log]
+    assert versions == list(range(1, ROUNDS + 1))      # no loss, no double
+    assert pool.ps.version == ROUNDS
+    epochs = [c["epoch"] for c in pool.commit_log]
+    assert epochs == sorted(epochs)                    # epochs monotonic
+    assert [tuple(c["contributors"]) for c in pool.commit_log] == [
+        (0, 1), (0, 1), (0, 1, 2), (0, 1, 2, 3), (0, 2, 3), (0, 2, 3),
+    ]
+
+    # -- the killed issue consumed no version; its survivors' deltas were
+    #    discarded at the pool, and nothing stale reached the weights ----
+    assert pool.stats["reformations"] == 1
+    assert pool.stats["discarded_reformation"] == 3   # one per survivor
+    assert pool.ps.rejected_stale == 0
+
+    # -- observability surfaces through SparkModel ------------------------
+    snap = sm.membership_snapshot()
+    assert snap["elastic"]["stats"]["rounds_committed"] == ROUNDS
+    hist = sm.training_histories[-1]
+    assert hist["mode"] == "elastic" and hist["reformations"] == 1

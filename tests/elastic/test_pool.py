@@ -1,0 +1,95 @@
+"""ElasticHostPool basics over REAL worker processes (numpy sgd task).
+
+Everything here crosses process boundaries for real: the hosts are
+subprocesses speaking the sockets.py framing to the driver's control plane.
+The sgd task keeps each host's boot under a second (no jax/keras import in
+the worker), so a whole fleet costs a few seconds per test.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from elephas_tpu.parallel.elastic import ElasticConfig, ElasticHostPool
+
+pytestmark = pytest.mark.elastic
+
+
+def _lsq_problem(seed=0, n=300, d=3):
+    rng = np.random.default_rng(seed)
+    w_true = np.array([1.0, -2.0, 3.0])[:d]
+    x = rng.normal(size=(n, d))
+    return x, x @ w_true, w_true
+
+
+def _run(cfg, plan=None, task_config=None, seed=0, n=300):
+    x, y, w_true = _lsq_problem(seed=seed, n=n)
+    pool = ElasticHostPool(
+        [np.zeros(x.shape[1])], cfg, task={"builtin": "sgd_task"},
+        task_config={"lr": 0.5, **(task_config or {})}, fault_plan=plan,
+    )
+    weights = pool.fit(x, y)
+    return pool, weights, w_true
+
+
+def test_static_pool_converges():
+    cfg = ElasticConfig(initial_hosts=2, rounds=5, lease_s=2.0,
+                        beat_interval_s=0.1)
+    pool, weights, w_true = _run(cfg)
+    losses = pool.history["loss"]
+    assert len(losses) == 5
+    assert losses[-1] < 0.1 * losses[0]
+    assert np.allclose(weights[0], w_true, atol=0.5)
+    # one commit per round, versions contiguous from 1
+    assert [c["version"] for c in pool.commit_log] == [1, 2, 3, 4, 5]
+    assert pool.stats["reformations"] == 0
+    assert pool.membership_trace == [("join", "host-0"), ("join", "host-1")]
+
+
+def test_scale_up_recuts_mesh():
+    cfg = ElasticConfig(initial_hosts=2, rounds=4, lease_s=2.0,
+                        beat_interval_s=0.1, scale_schedule={2: 4})
+    pool, _, _ = _run(cfg)
+    # mesh history records each distinct formation: 2 hosts then 4
+    assert [m["num_hosts"] for m in pool.mesh_history] == [2, 4]
+    assert [len(c["contributors"]) for c in pool.commit_log] == [2, 2, 4, 4]
+    assert pool.membership_trace == [
+        ("join", "host-0"), ("join", "host-1"),
+        ("join", "host-2"), ("join", "host-3"),
+    ]
+    # epochs in the commit log are non-decreasing and bump at the scale-up
+    epochs = [c["epoch"] for c in pool.commit_log]
+    assert epochs == sorted(epochs) and epochs[2] > epochs[1]
+
+
+def test_scale_down_retires_gracefully():
+    cfg = ElasticConfig(initial_hosts=3, rounds=4, lease_s=2.0,
+                        beat_interval_s=0.1, scale_schedule={2: 2})
+    pool, _, _ = _run(cfg)
+    assert [len(c["contributors"]) for c in pool.commit_log] == [3, 3, 2, 2]
+    # graceful scale-down is a LEAVE (fenced), not an expiry
+    assert ("leave", "host-2") in pool.membership_trace
+    assert not any(kind == "expire" for kind, _ in pool.membership_trace)
+
+
+def test_device_weighted_sharding():
+    cfg = ElasticConfig(initial_hosts=2, rounds=2, lease_s=2.0,
+                        beat_interval_s=0.1, devices_per_host=2)
+    x, y, _ = _lsq_problem(n=200)
+    pool = ElasticHostPool([np.zeros(3)], cfg, task={"builtin": "sgd_task"},
+                           task_config={"lr": 0.5})
+    pool.fit(x, y)
+    assert pool.mesh_history[0]["total_devices"] == 4
+    assert pool.mesh_history[0]["hosts"] == [(0, 2), (1, 2)]
+
+
+def test_snapshot_json_round_trips():
+    cfg = ElasticConfig(initial_hosts=2, rounds=2, lease_s=2.0,
+                        beat_interval_s=0.1)
+    pool, _, _ = _run(cfg)
+    snap = json.loads(json.dumps(pool.snapshot()))
+    assert snap["stats"]["rounds_committed"] == 2
+    assert snap["parameter_server"]["version"] == 2
+    assert [c["version"] for c in snap["commit_log"]] == [1, 2]
+    assert snap["registry"]["membership"]["epoch"] >= 2

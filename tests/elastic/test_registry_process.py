@@ -1,0 +1,105 @@
+"""HeartbeatRegistry driven by REAL processes (satellite coverage).
+
+The registry's unit tests (tests/resilience/test_membership.py) drive it
+with a fake clock and threads. Here the beats come from actual worker
+processes over TCP via the elastic pool, and the properties under test are
+the ones process-level chaos can break: snapshot JSON round-trips, epochs
+strictly monotonic under concurrent join/expire, and ``fence()`` rejecting
+a member whose lease expired between launch and commit.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from elephas_tpu.parallel.elastic import ElasticConfig, ElasticHostPool
+from elephas_tpu.resilience.faults import FaultPlan
+from elephas_tpu.resilience.membership import HeartbeatRegistry
+
+pytestmark = pytest.mark.elastic
+
+
+_MEMO = {}
+
+
+def _chaos_pool():
+    """A 3-host fleet (real processes) with one heartbeat partition: the
+    registry sees joins from live processes, an expiry from a lease lapse,
+    and a late (fenced) result from the zombie. Run once, inspected by
+    several tests (the pool is closed; its state is what's under test)."""
+    if "pool" in _MEMO:
+        return _MEMO["pool"]
+    rng = np.random.default_rng(2)
+    x = rng.normal(size=(200, 3))
+    y = x @ np.array([1.0, -2.0, 3.0])
+    pool = ElasticHostPool(
+        [np.zeros(3)],
+        ElasticConfig(initial_hosts=3, rounds=3, lease_s=1.5,
+                      beat_interval_s=0.1),
+        task={"builtin": "sgd_task"},
+        task_config={"lr": 0.5, "sleep_s": 0.1},
+        fault_plan=FaultPlan(seed=3, partition_hosts={1: 2}),
+    )
+    pool.fit(x, y)
+    _MEMO["pool"] = pool
+    return pool
+
+
+def test_snapshot_json_round_trips_from_process_run():
+    pool = _chaos_pool()
+    snap = pool.registry.snapshot()
+    restored = json.loads(json.dumps(snap))
+    assert restored == snap
+    assert restored["membership"]["live"] == ["host-0", "host-1"]
+    assert restored["counters"]["join"] == 3
+    assert restored["counters"]["expire"] == 1
+    assert restored["counters"]["late_reject"] == 1
+
+
+def test_epochs_strictly_monotonic_under_process_churn():
+    pool = _chaos_pool()
+    events = pool.registry.snapshot()["events"]
+    bumping = [e for e in events
+               if e["kind"] in ("join", "rejoin", "leave", "expire")]
+    epochs = [e["epoch"] for e in bumping]
+    # every membership transition bumps: strictly increasing, no reuse
+    assert epochs == sorted(epochs)
+    assert len(set(epochs)) == len(epochs)
+    # and the non-transition events never exceed the current epoch
+    assert max(e["epoch"] for e in events) == pool.registry.epoch
+
+
+def test_fence_rejects_lease_expired_between_launch_and_commit():
+    """The exact zombie interleaving, against the real registry clock:
+    work launched at epoch E, the member's lease expires (fence moves past
+    E), the result shows up at commit time — fence() must reject it."""
+    clock = {"now": 0.0}
+    registry = HeartbeatRegistry(lease_s=1.0, clock=lambda: clock["now"])
+    registry.join("host-0")
+    registry.join("host-1")
+    launch_epoch = registry.epoch
+    # host-1 beats; host-0 goes silent past its lease
+    clock["now"] = 1.5
+    registry.heartbeat("host-1")
+    expired = registry.sweep()
+    assert expired == ["host-0"]
+    # commit-time check: host-0's result was launched below its fence
+    assert launch_epoch < registry.fence("host-0")
+    assert not registry.is_live("host-0")
+    # the survivor's results are NOT fenced
+    assert launch_epoch >= registry.fence("host-1")
+    # and the process-level pool enforces exactly this: the zombie's delta
+    # ended in rejected_stale (see test_chaos_elastic for the full pin)
+
+
+def test_pool_registry_fence_reflects_partition():
+    pool = _chaos_pool()
+    # launched at the pre-expiry epoch, fenced at the expiry epoch
+    assert pool.registry.fence("host-2") > 0
+    assert pool.ps.rejected_stale == 1
+    snap = pool.registry.snapshot()
+    fences = snap["membership"]["fences"]
+    assert "host-2" in fences
+    rejects = [e for e in snap["events"] if e["kind"] == "late_reject"]
+    assert rejects and rejects[0]["detail"]["launch_epoch"] < fences["host-2"]

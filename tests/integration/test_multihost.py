@@ -56,12 +56,22 @@ print("LOSSES", [round(v, 6) for v in h], flush=True)
 """
 
 
-def _free_port() -> int:
+def _reserved_port():
+    """A bound-and-held listener socket plus its port.
+
+    The old ``_free_port`` bound, read the port, and CLOSED the socket
+    before the workers launched — a TOCTOU window in which any other suite
+    process could steal the port (the deflake target). Holding the bound
+    socket with ``SO_REUSEADDR`` keeps the port reserved until the
+    coordinator worker is actually ready to bind it; ``SO_REUSEADDR`` lets
+    that bind succeed while our listener is still in the kernel's tables.
+    """
     import socket
 
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind(("127.0.0.1", 0))
+    return s, s.getsockname()[1]
 
 
 @pytest.mark.multihost
@@ -71,9 +81,6 @@ def _free_port() -> int:
     reason="Multiprocess computations aren't implemented on the CPU backend",
 )
 def test_two_process_fit(tmp_path):
-    port = _free_port()
-    script = tmp_path / "worker.py"
-    script.write_text(_WORKER % {"port": port})
     env = dict(os.environ)
     env.update({
         "PALLAS_AXON_POOL_IPS": "",
@@ -83,20 +90,44 @@ def test_two_process_fit(tmp_path):
         "PYTHONPATH": os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__)))),
     })
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(script), str(pid)],
-            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
-            text=True,
-        )
-        for pid in (0, 1)
-    ]
+
+    def _launch():
+        holder, port = _reserved_port()
+        script = tmp_path / "worker.py"
+        script.write_text(_WORKER % {"port": port})
+        procs = [
+            subprocess.Popen(
+                [sys.executable, str(script), str(pid)],
+                env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True,
+            )
+            for pid in (0, 1)
+        ]
+        holder.close()  # released only once the fleet is launching
+        return procs
+
+    procs = _launch()
+    outs = None
     try:
         outs = [p.communicate(timeout=420)[0] for p in procs]
+        # One retry for residual bind races (the reservation shrinks the
+        # window to the holder-close → coordinator-bind gap; it cannot
+        # close it entirely from outside the coordinator process).
+        if any(p.returncode != 0 for p in procs) and any(
+            "Address already in use" in out for out in outs
+        ):
+            procs = _launch()
+            outs = [p.communicate(timeout=420)[0] for p in procs]
     finally:
+        # Reap unconditionally: kill() alone leaves a zombie Popen on the
+        # timeout path; wait() collects it.
         for p in procs:
             if p.poll() is None:
                 p.kill()
+            try:
+                p.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
     for p, out in zip(procs, outs):
         assert p.returncode == 0, out[-2000:]
     # SPMD: both processes must observe identical merged training histories
