@@ -8,8 +8,8 @@ TEST_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 KERAS_BACKEND=jax
 
 .PHONY: test test-fast test-chaos test-perf test-spec test-streaming \
-	test-fleet test-elastic bench bench-serving bench-paged bench-lm \
-	bench-spec bench-fleet bench-elastic
+	test-fleet test-elastic test-paged bench bench-serving bench-paged \
+	bench-lm bench-spec bench-fleet bench-elastic
 
 test:
 	$(TEST_ENV) bash scripts/run_tests.sh -x -q
@@ -50,6 +50,12 @@ test-fleet:
 test-elastic:
 	ELEPHAS_TEST_GROUP=elastic $(TEST_ENV) bash scripts/run_tests.sh -x -q
 
+# Paged-KV pins only (fused paged-attention kernel oracles, dense-vs-paged
+# token-identity fuzz over the knob cross-product, page-boundary
+# speculative accepts, and the PagesExhausted-mid-window chaos).
+test-paged:
+	ELEPHAS_TEST_GROUP=paged $(TEST_ENV) bash scripts/run_tests.sh -x -q
+
 bench:
 	KERAS_BACKEND=jax python bench.py
 
@@ -70,7 +76,9 @@ bench-spec:
 	print(json.dumps({'spec_decode': bench.bench_spec_decode(3)}))"
 
 # Paged-KV bench only: concurrency at a fixed KV HBM budget (dense slots
-# vs the paged pool) plus the prefix-cache hit ratio.
+# vs the paged pool), the prefix-cache hit ratio, and the equal-batch
+# per-step decode-time cell with copy_bytes_per_step (fused kernels move
+# O(new tokens) per step, not the O(context) gather round trip).
 bench-paged:
 	KERAS_BACKEND=jax python -c "import json, bench; \
 	print(json.dumps({'paged_kv': bench.bench_paged_kv(3)}))"

@@ -630,21 +630,33 @@ def bench_serving_fastpath(reps: int):
             int(np.prod(a.shape)) * a.dtype.itemsize
             for a in _jax.tree_util.tree_leaves(
                 ServingEngine(model, params, n_slots=slots).kv.cache))
-        paged_bytes = ServingEngine(
+        paged_stats = ServingEngine(
             model, params, n_slots=slots, paged=True, page_size=page,
             pages_per_partition=slots * per_req_pages + 1,
-        ).kv.memory_stats()["kv_hbm_bytes"]
+        ).kv.memory_stats()
+        paged_bytes = paged_stats["kv_hbm_bytes"]
         out[f"slots{slots}"] = {
             "single_tok_s": round(best1, 1),
             "fused_tok_s": round(bestk, 1),
             "speedup": round(bestk / best1, 2),
             "kv_hbm_bytes_per_request_dense": dense_bytes // slots,
             "kv_hbm_bytes_per_request_paged": paged_bytes // slots,
+            # per-decode-step KV traffic on the paged engine: the fused
+            # kernels write one new row per live slot (O(new tokens));
+            # the retired gather-to-dense path moved the whole pool span
+            # there and back every step (O(context))
+            "copy_bytes_per_step":
+                paged_stats["copy_bytes_per_token"] * slots,
+            "copy_bytes_per_step_gathered":
+                paged_stats["copy_bytes_per_step_gathered"] * slots,
         }
         log(f"serving fastpath: slots={slots} "
             f"{out[f'slots{slots}']['speedup']:.2f}x fused speedup, "
             f"KV/req dense {dense_bytes // slots:,}B "
-            f"vs paged {paged_bytes // slots:,}B")
+            f"vs paged {paged_bytes // slots:,}B, paged step moves "
+            f"{out[f'slots{slots}']['copy_bytes_per_step']:,}B "
+            f"(gathered would be "
+            f"{out[f'slots{slots}']['copy_bytes_per_step_gathered']:,}B)")
     out["config"] = (f"d{d_model}xL{n_layers}xH{n_heads}-V{vocab}"
                      f"-p{prompt_len}n{max_new}")
     # judged speculative-decoding entry rides in the fastpath section (it
@@ -803,9 +815,17 @@ def bench_paged_kv(reps: int):
     requests CONCURRENTLY inside the identical budget — the headline is
     the peak-concurrency ratio, with decode tok/s and the prefix-cache
     hit ratio (every request shares the system-prefix page) alongside.
-    Greedy outputs are asserted token-identical between the engines. Skip
-    with BENCH_SERVING=0; geometry via BENCH_PAGED_{DMODEL,LAYERS,VOCAB,
-    MAXLEN,PAGE,DENSE_SLOTS,PAGED_SLOTS,PROMPT,NEW}.
+    Greedy outputs are asserted token-identical between the engines.
+
+    A second judged cell times ONE steady decode step on each engine at
+    EQUAL batch (``dense_slots`` live rows on both): since the fused
+    paged kernels attend straight over the page pool, the paged step
+    should track the dense step instead of paying a gather-to-dense
+    round trip, and ``copy_bytes_per_step`` (actual per-step KV traffic,
+    O(new tokens)) is reported next to the O(context) bytes the retired
+    gather/scatter path would have moved. Skip with BENCH_SERVING=0;
+    geometry via BENCH_PAGED_{DMODEL,LAYERS,VOCAB,MAXLEN,PAGE,
+    DENSE_SLOTS,PAGED_SLOTS,PROMPT,NEW}.
     """
     import numpy as np
 
@@ -871,6 +891,24 @@ def bench_paged_kv(reps: int):
         fins = [eng.result(r, pop=False) for r in ids]
         return n_requests * max_new / dt, peak, [f.tokens for f in fins], eng
 
+    def decode_step_ms(paged_engine):
+        """Steady-state per-step decode latency at EQUAL batch: fill
+        ``dense_slots`` rows on either engine, then time pure decode
+        steps (prefills done, no admissions, budgets far from done)."""
+        kw = (dict(n_slots=dense_slots, paged=True, page_size=page,
+                   pages_per_partition=pool_pages) if paged_engine
+              else dict(n_slots=dense_slots))
+        eng = ServingEngine(model, params, max_queue=2 * n_requests, **kw)
+        for p in prompts[:dense_slots]:
+            eng.submit(p, 8 * max_new)       # long budget: stay in decode
+            eng.step()                       # prefill each as it lands
+        eng.step()                           # first decode step compiles
+        n_timed = 24
+        t0 = time.perf_counter()
+        for _ in range(n_timed):
+            eng.step()
+        return (time.perf_counter() - t0) / n_timed * 1e3
+
     log(f"paged kv: dense {dense_slots} slots vs paged {paged_slots} slots "
         f"at {dense_slots * max_len} KV token-positions (compiling...)")
     run(n_slots=dense_slots)                 # warmup/compile both engines
@@ -892,10 +930,15 @@ def bench_paged_kv(reps: int):
             best_p, peak_p, toks_p, eng_p = rp, pp, op, ep
     for got, want in zip(toks_p, toks_d):
         np.testing.assert_array_equal(got, want)  # same tokens, more of them
+    log("paged kv: timing one decode step at equal batch (compiling...)")
+    decode_step_ms(False), decode_step_ms(True)   # warm both step paths
+    step_d = min(decode_step_ms(False) for _ in range(max(1, reps)))
+    step_p = min(decode_step_ms(True) for _ in range(max(1, reps)))
     dense_bytes = sum(
         int(np.prod(a.shape)) * a.dtype.itemsize
         for a in jax.tree_util.tree_leaves(eng_d.kv.cache))
     mem = eng_p.snapshot()["memory"]
+    stats = eng_p.kv.memory_stats()
     out = {
         "page_size": page,
         "kv_hbm_budget_bytes": dense_bytes,
@@ -914,13 +957,31 @@ def bench_paged_kv(reps: int):
             "preemptions": mem["preemptions"],
         },
         "concurrency_ratio": round(peak_p / max(1, peak_d), 2),
+        # per-step decode latency at EQUAL batch (dense_slots live rows
+        # on both engines): the fused kernels attend straight over the
+        # pool, so paged should track dense, not pay a gather round trip
+        "decode_step": {
+            "batch": dense_slots,
+            "dense_step_ms": round(step_d, 3),
+            "paged_step_ms": round(step_p, 3),
+            "step_time_ratio": round(step_p / max(step_d, 1e-9), 2),
+        },
+        # actual per-step KV traffic (O(new tokens): one [L,2,Hkv,Dh]
+        # row per live slot) vs what the retired gather-to-dense path
+        # would have moved per slot (O(context): the whole span + back)
+        "copy_bytes_per_step": stats["copy_bytes_per_token"] * dense_slots,
+        "copy_bytes_per_step_gathered":
+            stats["copy_bytes_per_step_gathered"] * dense_slots,
         "config": (f"d{d_model}xL{n_layers}xH{n_heads}-V{vocab}"
                    f"-p{prompt_len}n{max_new}-T{max_len}"),
     }
     assert mem["kv_hbm_bytes"] <= dense_bytes, "paged pool exceeds budget"
     log(f"paged kv: {out['concurrency_ratio']:.1f}x concurrency at fixed "
         f"HBM, prefix hit ratio "
-        f"{out['paged']['prefix_hit_ratio']:.2f}")
+        f"{out['paged']['prefix_hit_ratio']:.2f}, equal-batch step "
+        f"paged/dense {out['decode_step']['step_time_ratio']:.2f}x, "
+        f"{out['copy_bytes_per_step']:,}B/step moved vs "
+        f"{out['copy_bytes_per_step_gathered']:,}B gathered")
     return out
 
 

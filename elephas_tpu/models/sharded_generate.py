@@ -61,6 +61,7 @@ from ..ops.flash_decode import (
     aligned_cache_length,
     decode_attention_lse,
 )
+from ..ops.paged_attention import paged_decode_attention_lse, paged_view_rows
 from ..parallel.mesh import DATA_AXIS
 from .transformer import (
     SEQ_AXIS,
@@ -70,8 +71,6 @@ from .transformer import (
     _period_ungroup,
     _rope_angles,
     _rope_rotate,
-    paged_gather_view,
-    paged_scatter_rows,
     select_slot_tokens,
     select_tokens,
     spec_verify_select,
@@ -643,6 +642,339 @@ def _verify_rows_sharded(model: TransformerLM, Tl: int, params, kc_all,
     return logits, kc_new, vc_new
 
 
+def _merged_paged_attention(qg, kp, vp, table, pos_local, Tl, page,
+                            window):
+    """Paged flash-decode partial + logsumexp merge over "seq": the paged
+    sibling of :func:`_merged_decode_attention`, reading K/V straight out
+    of this partition's page pool slice through the local block table
+    instead of a gathered dense view. Same clamp/invalid handling — ranks
+    with nothing visible drop out of the merge with −inf lse per row —
+    and on CPU :func:`paged_decode_attention_lse` resolves to the
+    gather-through-table reference whose math is bitwise the dense
+    kernel's, so the merged output equals the dense path's exactly."""
+    if window is None:
+        pos_cl = jnp.clip(pos_local, 0, Tl - 1)
+        invalid = pos_local < 0
+    else:
+        w = int(window)
+        pos_cl = jnp.clip(pos_local, 0, Tl + w - 2)
+        invalid = (pos_local < 0) | (pos_local - w + 1 >= Tl)
+    o_r, lse_r = paged_decode_attention_lse(qg, kp, vp, table, pos_cl,
+                                            page, window=window)
+    invalid = jnp.asarray(invalid)
+    if invalid.ndim == 1:                        # per-row → [B, 1, 1]
+        invalid = invalid[:, None, None]
+    lse_r = jnp.where(invalid, -jnp.inf, lse_r)
+    m = jax.lax.pmax(lse_r, SEQ_AXIS)
+    w_r = jnp.exp(lse_r - m)                     # [B, Hkv, G]
+    num = jax.lax.psum(w_r[..., None] * o_r, SEQ_AXIS)
+    den = jax.lax.psum(w_r, SEQ_AXIS)
+    return num / den[..., None]                  # [B, Hkv, G, Dh]
+
+
+def _paged_decode_step_sharded(model: TransformerLM, params, token, p,
+                               pool, table, page: int, Tl: int):
+    """One merged decode step DIRECTLY over the local page-pool shard:
+    the paged sibling of :func:`_decode_step_sharded`. ``pool``
+    ``{"k"/"v": [L, Pl, Hkv, page, Dh]}`` is this partition's slice,
+    ``table`` ``[Sl, Ml]`` its local block-table block. Each layer
+    scatters the one new K/V row of every OWNER slot into its owning page
+    (non-owner seq ranks and unmapped cells write into the trash page —
+    finite garbage the mask never shows) and attends through the table
+    with :func:`_merged_paged_attention`; no dense view is ever
+    materialized. Returns ``(logits [Sl, V], new_pool)``."""
+    B = token.shape[0]
+    H = model.n_heads
+    Hkv = model.n_kv_heads
+    Dh = model.d_model // H
+    cd = model.compute_dtype
+    r = jax.lax.axis_index(SEQ_AXIS)
+    pos_local = p - r * Tl                       # [B]
+    own_seq = (pos_local >= 0) & (pos_local < Tl)
+    idx = jnp.clip(pos_local, 0, Tl - 1)
+    pids = jnp.where(
+        own_seq,
+        jnp.take_along_axis(table, (idx // page)[:, None], axis=1)[:, 0],
+        0)
+    offs = idx % page
+
+    pos_b = jnp.broadcast_to(p, (B,))
+    h = model._embed(params, token, pos_b)       # [B, D]
+    if model.pos_encoding == "rotary":
+        r_cos, r_sin = _rope_angles(pos_b, Dh, model.rope_theta)
+        r_cos, r_sin = r_cos[:, None, :], r_sin[:, None, :]
+
+    def one_layer(h, lp, kp, vp, window):
+        # kp/vp [Pl, Hkv, page, Dh] — this partition's pool slice
+        x = model._norm_h(lp, "ln1", h).astype(cd)
+        q = model._attn_proj(lp, "q", x).reshape(B, H, Dh)
+        k_new = model._attn_proj(lp, "k", x).reshape(B, Hkv, Dh)
+        v_new = model._attn_proj(lp, "v", x).reshape(B, Hkv, Dh)
+        if model.pos_encoding == "rotary":
+            q = _rope_rotate(q, r_cos, r_sin)
+            k_new = _rope_rotate(k_new, r_cos, r_sin)
+        kp = kp.at[pids, :, offs].set(k_new, mode="drop")
+        vp = vp.at[pids, :, offs].set(v_new, mode="drop")
+        qg = q.reshape(B, Hkv, H // Hkv, Dh)
+        a = _merged_paged_attention(qg, kp, vp, table, pos_local, Tl,
+                                    page, window)
+        a = a.astype(cd).reshape(B, H, Dh)
+        h = h + model._attn_proj(lp, "o", a.reshape(B, model.d_model))
+        x = model._norm_h(lp, "ln2", h).astype(cd)
+        out, _ = model._ffn(lp, x[:, None, :], "ring", SEQ_AXIS,
+                            ep_groups=1)
+        return h + out[:, 0].astype(cd), kp, vp
+
+    pp = model._window_period()
+
+    def block(h, inputs):
+        lp, kp, vp = inputs
+        if pp == 1:
+            h, kp, vp = one_layer(h, lp, kp, vp, model.attn_windows[0])
+            return h, (kp, vp)
+        kps, vps = [], []
+        for g in range(pp):
+            h, kp_g, vp_g = one_layer(
+                h, {k: v[g] for k, v in lp.items()}, kp[g], vp[g],
+                model.attn_windows[g])
+            kps.append(kp_g)
+            vps.append(vp_g)
+        return h, (jnp.stack(kps), jnp.stack(vps))
+
+    lps = {k: params[k] for k in model._block_keys()}
+    ck, cv = pool["k"], pool["v"]
+    if pp > 1:
+        lps = _period_group(lps, pp)
+        ck = _period_group(ck, pp)
+        cv = _period_group(cv, pp)
+    h, (kc_new, vc_new) = jax.lax.scan(block, h, (lps, ck, cv))
+    if pp > 1:
+        kc_new = _period_ungroup(kc_new, model.n_layers)
+        vc_new = _period_ungroup(vc_new, model.n_layers)
+    h = model._norm_h(params, "lnf", h)
+    return model._logits(params, h), {"k": kc_new, "v": vc_new}
+
+
+def _paged_chunk_row_sharded(model: TransformerLM, Tl: int, page: int,
+                             params, pool, trow, tokens, t_last, pos0,
+                             own):
+    """Chunk-continuation forward of ``tokens`` ``[1, C]`` DIRECTLY over
+    the partition's pool slice through ONE slot's local block-table row
+    ``trow`` ``[1, Ml]``: the paged sibling of :func:`_chunk_row_sharded`.
+    Each layer scatters only the chunk's own K/V rows into their owning
+    pages (out-of-slice and non-owner writes land in the trash page), then
+    scores against a TRANSIENT gathered view of the slot's local slice —
+    the view's time axis equals ``Tl``, so the score/psum block below is
+    verbatim the dense chunk's and the merged logits stay bitwise
+    identical. Adopted prefix pages are attended but never rewritten.
+    Returns ``(last [V], new_pool)``."""
+    C = tokens.shape[1]
+    H = model.n_heads
+    Hkv = model.n_kv_heads
+    Dh = model.d_model // H
+    cd = model.compute_dtype
+    Ml = trow.shape[1]
+    r_seq = jax.lax.axis_index(SEQ_AXIS)
+
+    pos_b = pos0 + jnp.arange(C)[None, :]           # [1, C] absolute
+    h = model._embed(params, tokens, pos_b)         # [1, C, D]
+    rope = model._rope_for(pos_b)
+    local_t = pos_b[0] - r_seq * Tl                 # [C]
+    valid = (local_t >= 0) & (local_t < Tl) & own
+    lt = jnp.clip(local_t, 0, Tl - 1)
+    pids = jnp.where(valid, jnp.take(trow[0], lt // page), 0)
+    offs = lt % page
+    slots_g = r_seq * Tl + jnp.arange(Tl)           # [Tl] global pos
+
+    def mask_for(window):
+        m = slots_g[None, None, :] <= pos_b[:, :, None]
+        if window is not None:
+            m &= slots_g[None, None, :] > pos_b[:, :, None] - window
+        return m
+
+    def one_layer(h, lp, kp, vp, window):
+        # kp/vp [Pl, Hkv, page, Dh] — this partition's pool slice
+        x = model._norm_h(lp, "ln1", h).astype(cd)
+        q = model._attn_proj(lp, "q", x).reshape(1, C, H, Dh)
+        k_new = model._attn_proj(lp, "k", x).reshape(1, C, Hkv, Dh)
+        v_new = model._attn_proj(lp, "v", x).reshape(1, C, Hkv, Dh)
+        if rope is not None:
+            q = _rope_rotate(q, *rope)
+            k_new = _rope_rotate(k_new, *rope)
+        kp = kp.at[pids, :, offs].set(k_new[0], mode="drop")
+        vp = vp.at[pids, :, offs].set(v_new[0], mode="drop")
+        # transient per-layer gather of the slot's local slice: content
+        # is exactly what the dense path's carried view holds here, so
+        # the einsum/psum block below is bitwise the dense chunk's
+        kc = paged_view_rows(kp, trow, page)        # [1, Hkv, Tl, Dh]
+        vc = paged_view_rows(vp, trow, page)
+        qg = q.transpose(0, 2, 1, 3).reshape(1, Hkv, H // Hkv, C, Dh)
+        scores = jnp.einsum(
+            "bkgsd,bktd->bkgst", qg, kc,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        ) * (Dh ** -0.5)
+        scores = jnp.where(mask_for(window)[:, None, None], scores,
+                           -jnp.inf)
+        m_r = jnp.max(scores, axis=-1)              # [1, Hkv, G, C]
+        m = jax.lax.pmax(m_r, SEQ_AXIS)
+        w = jnp.exp(scores - m[..., None])
+        s_r = jnp.sum(w, axis=-1)
+        o_r = jnp.einsum(
+            "bkgst,bktd->bkgsd", w, vc,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        den = jax.lax.psum(s_r, SEQ_AXIS)
+        num = jax.lax.psum(o_r, SEQ_AXIS)
+        a = (num / den[..., None]).astype(cd)       # [1, Hkv, G, C, Dh]
+        a = a.reshape(1, H, C, Dh).transpose(0, 2, 1, 3)
+        h = h + model._attn_proj(lp, "o", a.reshape(1, C, model.d_model))
+        x = model._norm_h(lp, "ln2", h).astype(cd)
+        out, _ = model._ffn(lp, x, "ring", SEQ_AXIS, ep_groups=1)
+        return h + out.astype(cd), kp, vp
+
+    pp = model._window_period()
+
+    def block(h, inputs):
+        lp, kp, vp = inputs
+        if pp == 1:
+            h, kp, vp = one_layer(h, lp, kp, vp, model.attn_windows[0])
+            return h, (kp, vp)
+        kps, vps = [], []
+        for g in range(pp):
+            h, kp_g, vp_g = one_layer(
+                h, {k: v[g] for k, v in lp.items()}, kp[g], vp[g],
+                model.attn_windows[g])
+            kps.append(kp_g)
+            vps.append(vp_g)
+        return h, (jnp.stack(kps), jnp.stack(vps))
+
+    lps = {k: params[k] for k in model._block_keys()}
+    ck, cv = pool["k"], pool["v"]
+    if pp > 1:
+        lps = _period_group(lps, pp)
+        ck = _period_group(ck, pp)
+        cv = _period_group(cv, pp)
+    h, (kc_new, vc_new) = jax.lax.scan(block, h, (lps, ck, cv))
+    if pp > 1:
+        kc_new = _period_ungroup(kc_new, model.n_layers)
+        vc_new = _period_ungroup(vc_new, model.n_layers)
+    h = model._norm_h(params, "lnf", h)
+    logits = model._logits(params, h)               # [1, C, V]
+    last = jax.lax.dynamic_index_in_dim(logits[0], t_last, axis=0,
+                                        keepdims=False)
+    # replicate the OWNER's logits (non-owner data ranks computed on an
+    # unwritten view — garbage h, masked out of the sum)
+    last = jax.lax.psum(jnp.where(own, last, 0.0), DATA_AXIS)
+    return last, {"k": kc_new, "v": vc_new}
+
+
+def _paged_verify_rows_sharded(model: TransformerLM, Tl: int, page: int,
+                               params, pool, table, chunk, pos):
+    """Speculative-verify forward over EVERY local slot row DIRECTLY over
+    the partition's pool slice: the paged sibling of
+    :func:`_verify_rows_sharded`, writing each layer's chunk K/V through
+    the block table (O(chunk) rows — rejected-tail rows included, exactly
+    the dense path's stale-dead rows; decode-era pages are never shared,
+    see ``serving/memory.py``) and scoring against a transient gathered
+    view whose time axis equals ``Tl`` — the einsum/psum block is
+    verbatim the dense verify's, keeping logits bitwise identical.
+    Returns ``(logits [S, C, V], new_pool)``."""
+    S, C = chunk.shape
+    H = model.n_heads
+    Hkv = model.n_kv_heads
+    Dh = model.d_model // H
+    cd = model.compute_dtype
+    r_seq = jax.lax.axis_index(SEQ_AXIS)
+
+    pos_b = pos[:, None] + jnp.arange(C)[None, :]   # [S, C] absolute
+    h = model._embed(params, chunk, pos_b)          # [S, C, D]
+    rope = model._rope_for(pos_b)
+    local_t = pos_b - r_seq * Tl                    # [S, C]
+    valid = (local_t >= 0) & (local_t < Tl)
+    lt = jnp.clip(local_t, 0, Tl - 1)
+    pids = jnp.where(valid,
+                     jnp.take_along_axis(table, lt // page, axis=1), 0)
+    offs = lt % page
+    slots_g = r_seq * Tl + jnp.arange(Tl)           # [Tl] global pos
+
+    def mask_for(window):
+        m = slots_g[None, None, :] <= pos_b[:, :, None]
+        if window is not None:
+            m &= slots_g[None, None, :] > pos_b[:, :, None] - window
+        return m
+
+    def one_layer(h, lp, kp, vp, window):
+        # kp/vp [Pl, Hkv, page, Dh] — this partition's pool slice
+        x = model._norm_h(lp, "ln1", h).astype(cd)
+        q = model._attn_proj(lp, "q", x).reshape(S, C, H, Dh)
+        k_new = model._attn_proj(lp, "k", x).reshape(S, C, Hkv, Dh)
+        v_new = model._attn_proj(lp, "v", x).reshape(S, C, Hkv, Dh)
+        if rope is not None:
+            q = _rope_rotate(q, *rope)
+            k_new = _rope_rotate(k_new, *rope)
+        kp = kp.at[pids, :, offs].set(k_new, mode="drop")
+        vp = vp.at[pids, :, offs].set(v_new, mode="drop")
+        kc = paged_view_rows(kp, table, page)       # [S, Hkv, Tl, Dh]
+        vc = paged_view_rows(vp, table, page)
+        qg = q.transpose(0, 2, 1, 3).reshape(S, Hkv, H // Hkv, C, Dh)
+        scores = jnp.einsum(
+            "bkgsd,bktd->bkgst", qg, kc,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        ) * (Dh ** -0.5)
+        scores = jnp.where(mask_for(window)[:, None, None], scores,
+                           -jnp.inf)
+        m_r = jnp.max(scores, axis=-1)              # [S, Hkv, G, C]
+        m = jax.lax.pmax(m_r, SEQ_AXIS)
+        w = jnp.exp(scores - m[..., None])
+        s_r = jnp.sum(w, axis=-1)
+        o_r = jnp.einsum(
+            "bkgst,bktd->bkgsd", w, vc,
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        den = jax.lax.psum(s_r, SEQ_AXIS)
+        num = jax.lax.psum(o_r, SEQ_AXIS)
+        a = (num / den[..., None]).astype(cd)       # [S, Hkv, G, C, Dh]
+        a = a.reshape(S, H, C, Dh).transpose(0, 2, 1, 3)
+        h = h + model._attn_proj(lp, "o", a.reshape(S, C, model.d_model))
+        x = model._norm_h(lp, "ln2", h).astype(cd)
+        out, _ = model._ffn(lp, x, "ring", SEQ_AXIS, ep_groups=1)
+        return h + out.astype(cd), kp, vp
+
+    pp = model._window_period()
+
+    def block(h, inputs):
+        lp, kp, vp = inputs
+        if pp == 1:
+            h, kp, vp = one_layer(h, lp, kp, vp, model.attn_windows[0])
+            return h, (kp, vp)
+        kps, vps = [], []
+        for g in range(pp):
+            h, kp_g, vp_g = one_layer(
+                h, {k: v[g] for k, v in lp.items()}, kp[g], vp[g],
+                model.attn_windows[g])
+            kps.append(kp_g)
+            vps.append(vp_g)
+        return h, (jnp.stack(kps), jnp.stack(vps))
+
+    lps = {k: params[k] for k in model._block_keys()}
+    ck, cv = pool["k"], pool["v"]
+    if pp > 1:
+        lps = _period_group(lps, pp)
+        ck = _period_group(ck, pp)
+        cv = _period_group(cv, pp)
+    h, (kc_new, vc_new) = jax.lax.scan(block, h, (lps, ck, cv))
+    if pp > 1:
+        kc_new = _period_ungroup(kc_new, model.n_layers)
+        vc_new = _period_ungroup(vc_new, model.n_layers)
+    h = model._norm_h(params, "lnf", h)
+    logits = model._logits(params, h)               # [S, C, V]
+    return logits, {"k": kc_new, "v": vc_new}
+
+
 class ServingOps(NamedTuple):
     """The sharded programs the serving engine drives (plus the cache
     factory matching their layout). Signatures are identical to the
@@ -927,6 +1259,8 @@ class PagedServingOps(NamedTuple):
     init_pool: Any     # () -> {"k"/"v": [L, dp·sp·Pl, Hkv, page, Dh]} placed
     upload_table: Any  # np [S, M] -> placed device table
     upload_aids: Any   # np [S] -> placed device adapter ids
+    scatter_table_row: Any  # (table_dev, slot, row[M]) -> table_dev (donated)
+    scatter_aids_row: Any   # (aids_dev, slot, aid) -> aids_dev (donated)
     insert: Any        # (params, pool, table, tokens[1,Tb], t_last, slot, pos0, aid) -> (last[V], pool)
     decode: Any        # (params, pool, table, aids, tok, pos, temps, keys, live) -> (emit, tok, pos, pool)
     decode_fused: Any  # (..., live, n_steps=K) -> (emit[S,K], tok, pos, pool)
@@ -955,14 +1289,20 @@ def build_paged_serving_ops(model: TransformerLM, mesh: Mesh, n_slots: int,
     page ids; cell ``(s, m)`` of the global ``[S, M]`` table belongs to
     partition ``(s // Sl)·sp + (m // Ml)``.
 
-    Every program gathers the dense per-slot view through the table
-    (:func:`paged_gather_view` — the view's time axis equals ``Tl``, so
-    the attention math and its reduction trees are EXACTLY the dense
-    programs': insert = prefill-then-slice, chunk = ``_chunk_row_sharded``,
-    decode = ``_decode_step_sharded``), then scatters only the written
-    rows/pages back, redirecting non-owner and unmapped writes to the
-    trash page. ``page_size`` must divide ``Tl`` — that equality of time
-    axes IS the bit-identity contract with the dense engine. Adapter ids
+    Every program runs DIRECTLY over the pool through the table — decode
+    and fused decode via :func:`_paged_decode_step_sharded` (per-layer
+    single-row page scatter + :func:`_merged_paged_attention`), chunk
+    continuations via :func:`_paged_chunk_row_sharded` and speculative
+    verify via :func:`_paged_verify_rows_sharded` (per-layer O(chunk)
+    page scatter, scores against a transient gathered view whose time
+    axis equals ``Tl``), insert via replicated prefill-then-slice
+    scattering only the pages the prompt actually covers. Non-owner and
+    unmapped writes land in the trash page. No per-step dense-layout
+    round trip remains, and the attention reduction trees match the dense
+    programs' exactly. ``page_size`` must divide ``Tl`` — that equality
+    of time axes IS the bit-identity contract with the dense engine
+    (on CPU every paged attention resolves to the gather-through-table
+    reference applying the dense math verbatim). Adapter ids
     ride along: the insert paths take one replicated scalar (logits must
     stay replicated), the decode paths a ``"data"``-sharded ``[S]``
     vector, both applied via the model's ``adapter_context`` when it has
@@ -1018,31 +1358,40 @@ def build_paged_serving_ops(model: TransformerLM, mesh: Mesh, n_slots: int,
         return jax.device_put(jnp.asarray(aids_np, jnp.int32),
                               NamedSharding(mesh, aids_spec))
 
-    def _scatter_local_row(pool, trow, own, new_k, new_v):
-        # write one slot's local [Tl] slice back as Ml whole pages:
-        # new_k/new_v [L, 1, Hkv, Tl, Dh]; trow [1, Ml] local page ids.
-        # Non-owner data ranks redirect every id to the trash page; so do
-        # unmapped table cells (already 0). Duplicate trash coordinates
-        # are undefined-pick — trash is never read unmasked.
-        ids = jnp.where(own, trow[0], 0)
-        out = {}
-        for n, new in (("k", new_k), ("v", new_v)):
-            vals = new[:, 0].reshape(L, Hkv, Ml, page, Dh)
-            vals = vals.transpose(0, 2, 1, 3, 4)   # [L, Ml, Hkv, page, Dh]
-            out[n] = pool[n].at[:, ids].set(vals, mode="drop")
-        return out
+    # device-resident table maintenance: one dirty slot row patched in
+    # place (donated) instead of re-uploading the whole host table
+    scatter_table_row = jax.jit(
+        lambda t, s, row: t.at[s].set(row),
+        donate_argnums=(0,),
+        out_shardings=NamedSharding(mesh, table_spec))
+    scatter_aids_row = jax.jit(
+        lambda a, s, aid: a.at[s].set(aid),
+        donate_argnums=(0,),
+        out_shardings=NamedSharding(mesh, aids_spec))
 
     def _paged_insert_impl(params, pool, table, tokens, t_last, slot, aid):
         # local: pool [L, Pl, Hkv, page, Dh], table [Sl, Ml]
         Sl_, Ml_ = table.shape
+        Tb = tokens.shape[1]                        # static chunk length
         r_data = jax.lax.axis_index(DATA_AXIS)
+        r_seq = jax.lax.axis_index(SEQ_AXIS)
         logits, new_k, new_v = _prefill_slice_sharded(
             model, capacity, Tl, params, tokens, aid=aid)
         slot_local = slot - r_data * Sl_
         own = (slot_local >= 0) & (slot_local < Sl_)
         idx = jnp.clip(slot_local, 0, Sl_ - 1)
         trow = jax.lax.dynamic_slice(table, (idx, 0), (1, Ml_))
-        pool = _scatter_local_row(pool, trow, own, new_k, new_v)
+        # scatter ONLY pages whose global span intersects the prompt —
+        # pages wholly past Tb are unmapped (cell 0) and would have
+        # carried zeros into the trash page; non-owner data ranks and
+        # unmapped cells redirect to the trash page. Duplicate trash
+        # coordinates are undefined-pick — trash is never read unmasked.
+        starts = r_seq * Tl + jnp.arange(Ml_) * page
+        ids = jnp.where(own & (starts < Tb), trow[0], 0)
+        for n, new in (("k", new_k), ("v", new_v)):
+            vals = new[:, 0].reshape(L, Hkv, Ml_, page, Dh)
+            vals = vals.transpose(0, 2, 1, 3, 4)    # [L, Ml, Hkv, pg, Dh]
+            pool[n] = pool[n].at[:, ids].set(vals, mode="drop")
         last = jax.lax.dynamic_index_in_dim(logits[0], t_last, axis=0,
                                             keepdims=False)
         return last, pool
@@ -1055,126 +1404,60 @@ def build_paged_serving_ops(model: TransformerLM, mesh: Mesh, n_slots: int,
         own = (slot_local >= 0) & (slot_local < Sl_)
         idx = jnp.clip(slot_local, 0, Sl_ - 1)
         trow = jax.lax.dynamic_slice(table, (idx, 0), (1, Ml_))
-        # surrogate rows on non-owner ranks, same as the dense chunk
-        row = {n: paged_gather_view(pool[n], trow, page)
-               for n in ("k", "v")}       # [L, 1, Hkv, Tl, Dh]
         with _adapter_ctx(model, jnp.reshape(aid, (1,))):
-            last, new_row = _chunk_row_sharded(model, Tl, params, row,
-                                               tokens, t_last, pos0, own)
-        pool = _scatter_local_row(pool, trow, own, new_row["k"],
-                                  new_row["v"])
+            last, pool = _paged_chunk_row_sharded(
+                model, Tl, page, params, pool, trow, tokens, t_last,
+                pos0, own)
         return last, pool
 
     def _paged_decode_impl(params, pool, table, aids, tokens, pos, temps,
                            keys, live):
-        # local: tokens/pos/temps/live/aids [Sl], keys [Sl, 2]
-        view = {n: paged_gather_view(pool[n], table, page)
-                for n in ("k", "v")}      # [L, Sl, Hkv, Tl, Dh]
+        # local: tokens/pos/temps/live/aids [Sl], keys [Sl, 2] — one
+        # fused step straight over the pool, no dense view round trip
         with _adapter_ctx(model, aids):
-            logits, kc, vc = _decode_step_sharded(
-                model, params, tokens, pos, view["k"], view["v"], Tl)
+            logits, pool = _paged_decode_step_sharded(
+                model, params, tokens, pos, pool, table, page, Tl)
         emit = select_slot_tokens(logits, pos + 1, temps, keys)
-        r_seq = jax.lax.axis_index(SEQ_AXIS)
-        pos_local = pos - r_seq * Tl
-        own_seq = (pos_local >= 0) & (pos_local < Tl)
-        idx = jnp.clip(pos_local, 0, Tl - 1)
-        pids = jnp.where(
-            own_seq,
-            jnp.take_along_axis(table, (idx // page)[:, None],
-                                axis=1)[:, 0], 0)
-        offs = idx % page
-        new_pool = {}
-        for n, v in (("k", kc), ("v", vc)):
-            rows = jnp.take_along_axis(
-                v, idx[None, :, None, None, None], axis=3)[:, :, :, 0]
-            new_pool[n] = paged_scatter_rows(pool[n], rows, pids, offs)
         tokens = jnp.where(live, emit, tokens)
         pos = jnp.where(live, pos + 1, pos)
-        return emit, tokens, pos, new_pool
+        return emit, tokens, pos, pool
 
     def _paged_fused_impl(n_steps, params, pool, table, aids, tokens, pos,
                           temps, keys, live):
-        view = {n: paged_gather_view(pool[n], table, page)
-                for n in ("k", "v")}
-
+        # the POOL itself is the scan carry: each step's layers write
+        # their one new row per slot into the owning page, so the whole
+        # window moves O(Sl · n_steps) rows
         def body(carry, _):
-            tok, p, kc, vc = carry
+            tok, p, pk, pv = carry
             with _adapter_ctx(model, aids):
-                logits, kc, vc = _decode_step_sharded(
-                    model, params, tok, p, kc, vc, Tl)
+                logits, new = _paged_decode_step_sharded(
+                    model, params, tok, p, {"k": pk, "v": pv}, table,
+                    page, Tl)
             emit = select_slot_tokens(logits, p + 1, temps, keys)
             tok = jnp.where(live, emit, tok)
             p = jnp.where(live, p + 1, p)
-            return (tok, p, kc, vc), emit
+            return (tok, p, new["k"], new["v"]), emit
 
-        (tokens_out, pos_out, kc, vc), emitted = jax.lax.scan(
-            body, (tokens, pos, view["k"], view["v"]), None,
+        (tokens_out, pos_out, pk, pv), emitted = jax.lax.scan(
+            body, (tokens, pos, pool["k"], pool["v"]), None,
             length=n_steps)
-
-        # flattened write-back of all S × K rows using the ORIGINAL pos
-        # (non-live rows repeat their write head — duplicate coordinates
-        # carry identical final-view values)
-        r_seq = jax.lax.axis_index(SEQ_AXIS)
-        S_ = pos.shape[0]
-        steps = jnp.arange(n_steps)
-        posj = jnp.where(live[:, None], pos[:, None] + steps[None, :],
-                         pos[:, None])                 # [Sl, K]
-        pos_local = posj - r_seq * Tl
-        own_seq = (pos_local >= 0) & (pos_local < Tl)
-        idx = jnp.clip(pos_local, 0, Tl - 1)
-        pids = jnp.where(own_seq,
-                         jnp.take_along_axis(table, idx // page, axis=1), 0)
-        offs = idx % page
-        new_pool = {}
-        for n, v in (("k", kc), ("v", vc)):
-            rows = jnp.take_along_axis(
-                v, idx[None, :, None, :, None], axis=3)  # [L,Sl,Hkv,K,Dh]
-            rows = rows.transpose(0, 1, 3, 2, 4).reshape(
-                L, S_ * n_steps, rows.shape[2], rows.shape[4])
-            new_pool[n] = paged_scatter_rows(pool[n], rows,
-                                             pids.reshape(S_ * n_steps),
-                                             offs.reshape(S_ * n_steps))
-        return emitted.T, tokens_out, pos_out, new_pool
+        return emitted.T, tokens_out, pos_out, {"k": pk, "v": pv}
 
     def _paged_verify_impl(params, pool, table, aids, drafts, tokens, pos,
                            temps, keys, live):
-        # speculative verify over the pool: dense-view gather, ONE chunk
-        # forward (bitwise the dense verify's math — the view's time axis
-        # equals Tl), then scatter back ONLY the accepted runs' rows; the
-        # rejected tail, non-live rows, and non-owner seq ranks all mask
-        # into the trash page, so rejected tokens leak no page content
-        view = {n: paged_gather_view(pool[n], table, page)
-                for n in ("k", "v")}      # [L, Sl, Hkv, Tl, Dh]
+        # speculative verify straight over the pool: ONE chunk forward
+        # writing O(chunk) rows through the table (rejected-tail rows
+        # included — decode-era pages are never shared, and the
+        # staleness-repair invariant rewrites them before any read)
         chunk = jnp.concatenate([tokens[:, None], drafts], axis=1)
         with _adapter_ctx(model, aids):
-            logits, kc, vc = _verify_rows_sharded(
-                model, Tl, params, view["k"], view["v"], chunk, pos)
+            logits, pool = _paged_verify_rows_sharded(
+                model, Tl, page, params, pool, table, chunk, pos)
         sel, n_acc = spec_verify_select(logits, drafts, pos, temps, keys)
         corr = jnp.take_along_axis(sel, n_acc[:, None], axis=1)[:, 0]
-        r_seq = jax.lax.axis_index(SEQ_AXIS)
-        S_, C = chunk.shape
-        steps = jnp.arange(C)
-        posj = jnp.where(live[:, None], pos[:, None] + steps[None, :],
-                         pos[:, None])                 # [Sl, C]
-        pos_local = posj - r_seq * Tl
-        own_seq = (pos_local >= 0) & (pos_local < Tl)
-        idx = jnp.clip(pos_local, 0, Tl - 1)
-        keep = own_seq & live[:, None] & (steps[None, :] <= n_acc[:, None])
-        pids = jnp.where(keep,
-                         jnp.take_along_axis(table, idx // page, axis=1), 0)
-        offs = idx % page
-        new_pool = {}
-        for n, v in (("k", kc), ("v", vc)):
-            rows = jnp.take_along_axis(
-                v, idx[None, :, None, :, None], axis=3)  # [L,Sl,Hkv,C,Dh]
-            rows = rows.transpose(0, 1, 3, 2, 4).reshape(
-                L, S_ * C, rows.shape[2], rows.shape[4])
-            new_pool[n] = paged_scatter_rows(pool[n], rows,
-                                             pids.reshape(S_ * C),
-                                             offs.reshape(S_ * C))
         tokens = jnp.where(live, corr, tokens)
         pos = jnp.where(live, pos + n_acc + 1, pos)
-        return sel, n_acc, tokens, pos, new_pool
+        return sel, n_acc, tokens, pos, pool
 
     insert_programs: Dict[int, Any] = {}
     chunk_programs: Dict[int, Any] = {}
@@ -1274,7 +1557,10 @@ def build_paged_serving_ops(model: TransformerLM, mesh: Mesh, n_slots: int,
                                   pos, temps, keys, live)
 
     return PagedServingOps(init_pool=init_pool, upload_table=upload_table,
-                           upload_aids=upload_aids, insert=insert,
+                           upload_aids=upload_aids,
+                           scatter_table_row=scatter_table_row,
+                           scatter_aids_row=scatter_aids_row,
+                           insert=insert,
                            decode=decode, decode_fused=decode_fused,
                            verify=verify,
                            max_len=max_len, capacity=capacity, Tl=Tl,

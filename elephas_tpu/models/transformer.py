@@ -42,6 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.flash_attention import flash_attention
 from ..ops.flash_decode import aligned_cache_length, decode_attention
+from ..ops.paged_attention import paged_chunk_attention, paged_decode_attention
 from ..ops.pallas_ops import is_tpu_backend
 from ..ops.ring_attention import attention_reference, ring_attention_local
 from ..ops.ulysses import ulysses_attention_local
@@ -588,39 +589,6 @@ def cache_scatter_slot(cache, slot, slot_cache):
                                                axis=1)
         for n, c in cache.items()
     }
-
-
-def paged_gather_view(pool, table, page: int):
-    """Materialize the dense per-slot view of a PAGED KV pool: ``pool``
-    ``[L, P, Hkv, page, Dh]`` (P physical pages; page 0 is the trash page)
-    read through ``table`` ``[S, M]`` int32 (per-slot block tables of
-    physical page ids) → ``[L, S, Hkv, M·page, Dh]``.
-
-    This is THE paged attention read: one gather puts every slot's logical
-    time axis back in dense layout, so the existing decode/chunk kernels
-    run unchanged on the view and stay bit-identical to the dense
-    ``SlotKVCache`` path — the view's time axis equals the dense capacity,
-    so the attention reductions group identically. Unallocated logical
-    pages read the trash page; everything there is at masked (``> pos``)
-    positions, whose contributions are exactly zero (finite garbage times
-    an exp(−inf) weight), so the view is safe by the same staleness-repair
-    invariant the dense cache relies on. XLA fuses the gather into the
-    attention consumer; a TPU Pallas kernel reading through the table
-    in-VMEM is the drop-in upgrade (see ops/flash_decode.py)."""
-    g = pool[:, table]                    # [L, S, M, Hkv, page, Dh]
-    L, S, M, Hkv, pg, Dh = g.shape
-    return g.transpose(0, 1, 3, 2, 4, 5).reshape(L, S, Hkv, M * pg, Dh)
-
-
-def paged_scatter_rows(pool, rows, pids, offs):
-    """Scatter one written time-row per slot back into the paged pool:
-    ``rows`` ``[L, S, Hkv, Dh]`` (the position each slot's decode step just
-    wrote, extracted from the dense view) lands at ``pool[:, pids[s], :,
-    offs[s]]``. Dead/non-owner slots pass ``pids == 0`` — the trash page
-    absorbs their garbage writes (duplicate trash coordinates may race;
-    trash is never read unmasked, so any winner is fine)."""
-    vals = rows.transpose(1, 0, 2, 3)     # [S, L, Hkv, Dh]
-    return pool.at[:, pids, :, offs].set(vals, mode="drop")
 
 
 def _adapter_ctx(model, rows):
@@ -1562,6 +1530,189 @@ class TransformerLM:
 
         lps = {k: params[k] for k in self._block_keys()}
         ck, cv = cache["k"], cache["v"]
+        if p > 1:
+            lps = _period_group(lps, p)
+            ck = _period_group(ck, p)
+            cv = _period_group(cv, p)
+        h, (kc_new, vc_new) = jax.lax.scan(block, h, (lps, ck, cv))
+        if p > 1:
+            kc_new = _period_ungroup(kc_new, self.n_layers)
+            vc_new = _period_ungroup(vc_new, self.n_layers)
+        h = self._norm_h(params, "lnf", h)
+        return self._logits(params, h), {"k": kc_new, "v": vc_new}
+
+    def decode_step_paged(self, params, token, pos, pool, table,
+                          page: int):
+        """One cached decode step DIRECTLY over a paged KV pool: ``token``
+        ``[B]`` at per-row positions ``pos`` ``[B]`` (scalar broadcasts)
+        against ``pool`` ``{"k"/"v": [L, P, Hkv, page, Dh]}`` read through
+        ``table`` ``[B, M]`` int32 (row ``b`` is slot ``b``'s block table)
+        → ``(logits [B, V] f32, new_pool)``.
+
+        The paged sibling of :meth:`decode_step`: same layer body, but
+        each layer scatters ONLY the newly produced K/V row into its
+        owning page (``pool[table[b, pos_b // page], :, pos_b % page]`` —
+        O(new tokens), not a gather/scatter of the whole context) and
+        attends through the block table with the fused paged kernel
+        (``ops/paged_attention.py`` — Pallas on TPU; on CPU the reference
+        gathers a transient view and applies the exact dense math, which
+        keeps paged logits BITWISE equal to :meth:`decode_step` on the
+        equivalent dense cache). Rows whose table cell at the write
+        position is unmapped (parked/freed slots) scatter into the
+        per-partition trash page (id 0) — finite garbage the position
+        mask keeps invisible. Rolling (all-windowed) caches are refused
+        (pages are linear-horizon only, like ``PagedKVCache``)."""
+        if self._ring_cache:
+            raise ValueError(
+                "decode_step_paged: paged pools are linear-horizon; "
+                "rolling (all-windowed) caches have no paged layout")
+        B = token.shape[0]
+        H = self.n_heads
+        Hkv = self.n_kv_heads
+        Dh = self.d_model // H
+        cd = self.compute_dtype
+        M = table.shape[1]
+        pos_b = jnp.broadcast_to(jnp.asarray(pos), (B,))
+        h = self._embed(params, token, pos_b)  # [B, D]
+        if self.pos_encoding == "rotary":
+            r_cos, r_sin = _rope_angles(pos_b, Dh, self.rope_theta)
+            r_cos, r_sin = r_cos[:, None, :], r_sin[:, None, :]
+
+        # write coordinates, shared by every layer: positions past the
+        # logical capacity (never produced by the serving engine) and
+        # unmapped cells both land in the trash page
+        mcell = jnp.clip(pos_b // page, 0, M - 1)
+        pids = jnp.where(
+            pos_b < M * page,
+            jnp.take_along_axis(table, mcell[:, None], axis=1)[:, 0], 0)
+        offs = pos_b % page
+
+        def one_layer(h, lp, kp, vp, window):
+            x = self._norm_h(lp, "ln1", h).astype(cd)
+            q = self._attn_proj(lp, "q", x).reshape(B, H, Dh)
+            k_new = self._attn_proj(lp, "k", x).reshape(B, Hkv, Dh)
+            v_new = self._attn_proj(lp, "v", x).reshape(B, Hkv, Dh)
+            if self.pos_encoding == "rotary":
+                # pages store PRE-ROTATED keys, like the dense cache
+                q = _rope_rotate(q, r_cos, r_sin)
+                k_new = _rope_rotate(k_new, r_cos, r_sin)
+            kp = kp.at[pids, :, offs].set(k_new, mode="drop")
+            vp = vp.at[pids, :, offs].set(v_new, mode="drop")
+            qg = q.reshape(B, Hkv, H // Hkv, Dh)
+            a = paged_decode_attention(
+                qg, kp, vp, table, pos_b, page, window=window
+            ).astype(cd).reshape(B, H, Dh)
+            h = h + self._attn_proj(lp, "o", a.reshape(B, self.d_model))
+            x = self._norm_h(lp, "ln2", h).astype(cd)
+            out, _ = self._ffn(lp, x[:, None, :], "dense", SEQ_AXIS,
+                               ep_groups=1)
+            return h + out[:, 0].astype(cd), kp, vp
+
+        p = self._window_period()
+
+        def block(h, inputs):
+            lp, kp, vp = inputs
+            if p == 1:
+                h, kp, vp = one_layer(h, lp, kp, vp, self.attn_windows[0])
+                return h, (kp, vp)
+            kps, vps = [], []
+            for g in range(p):
+                h, kp_g, vp_g = one_layer(
+                    h, {k: v[g] for k, v in lp.items()}, kp[g], vp[g],
+                    self.attn_windows[g])
+                kps.append(kp_g)
+                vps.append(vp_g)
+            return h, (jnp.stack(kps), jnp.stack(vps))
+
+        lps = {k: params[k] for k in self._block_keys()}
+        ck, cv = pool["k"], pool["v"]
+        if p > 1:
+            lps = _period_group(lps, p)
+            ck = _period_group(ck, p)
+            cv = _period_group(cv, p)
+        h, (kc_new, vc_new) = jax.lax.scan(block, h, (lps, ck, cv))
+        if p > 1:
+            kc_new = _period_ungroup(kc_new, self.n_layers)
+            vc_new = _period_ungroup(vc_new, self.n_layers)
+        h = self._norm_h(params, "lnf", h)
+        return self._logits(params, h), {"k": kc_new, "v": vc_new}
+
+    def decode_chunk_paged(self, params, tokens, pos0, pool, table,
+                           page: int):
+        """Cached forward of a BLOCK of ``S`` tokens per row DIRECTLY over
+        a paged pool: the paged sibling of :meth:`decode_chunk`, serving
+        paged prefill-insert, chunked-prefill continuations, and
+        speculative verify. Each layer scatters the chunk's ``S`` new K/V
+        rows through the block table (O(chunk), never the whole row of
+        pages — already-shared prefix pages are never rewritten), then
+        attends all queries through the table with the fused multi-row
+        kernel; the CPU reference applies :meth:`decode_chunk`'s exact
+        attention math to a transient gathered view, so logits stay
+        BITWISE equal to the dense chunk path. Positions past the logical
+        capacity or without a mapped page (bucket padding, parked rows)
+        write to the trash page; the staleness-repair invariant
+        (:meth:`generate_speculative`) covers them exactly as it covers
+        the dense cache's stale rows."""
+        if self._ring_cache:
+            raise ValueError(
+                "decode_chunk_paged: paged pools are linear-horizon; "
+                "rolling (all-windowed) caches have no paged layout")
+        B, S = tokens.shape
+        H = self.n_heads
+        Hkv = self.n_kv_heads
+        Dh = self.d_model // H
+        cd = self.compute_dtype
+        M = table.shape[1]
+        pos0 = jnp.asarray(pos0)
+        pos_b = jnp.broadcast_to(pos0.reshape(-1, 1), (B, 1)) + \
+            jnp.arange(S)[None, :]  # [B, S] absolute positions per row
+        h = self._embed(params, tokens, pos_b)  # [B, S, D]
+        rope = self._rope_for(pos_b)
+
+        mcell = jnp.clip(pos_b // page, 0, M - 1)
+        pids = jnp.where(pos_b < M * page,
+                         jnp.take_along_axis(table, mcell, axis=1), 0)
+        offs = pos_b % page                     # [B, S]
+        pos0_b = pos_b[:, 0]
+
+        def one_layer(h, lp, kp, vp, window):
+            x = self._norm_h(lp, "ln1", h).astype(cd)
+            q = self._attn_proj(lp, "q", x).reshape(B, S, H, Dh)
+            k_new = self._attn_proj(lp, "k", x).reshape(B, S, Hkv, Dh)
+            v_new = self._attn_proj(lp, "v", x).reshape(B, S, Hkv, Dh)
+            if rope is not None:
+                q = _rope_rotate(q, *rope)
+                k_new = _rope_rotate(k_new, *rope)
+            kp = kp.at[pids, :, offs].set(k_new, mode="drop")
+            vp = vp.at[pids, :, offs].set(v_new, mode="drop")
+            qg = q.transpose(0, 2, 1, 3).reshape(B, Hkv, H // Hkv, S, Dh)
+            a = paged_chunk_attention(
+                qg, kp, vp, table, pos0_b, page, window=window
+            ).astype(cd)
+            a = a.reshape(B, H, S, Dh).transpose(0, 2, 1, 3)
+            h = h + self._attn_proj(lp, "o", a.reshape(B, S, self.d_model))
+            x = self._norm_h(lp, "ln2", h).astype(cd)
+            out, _ = self._ffn(lp, x, "dense", SEQ_AXIS, ep_groups=1)
+            return h + out.astype(cd), kp, vp
+
+        p = self._window_period()
+
+        def block(h, inputs):
+            lp, kp, vp = inputs
+            if p == 1:
+                h, kp, vp = one_layer(h, lp, kp, vp, self.attn_windows[0])
+                return h, (kp, vp)
+            kps, vps = [], []
+            for g in range(p):
+                h, kp_g, vp_g = one_layer(
+                    h, {k: v[g] for k, v in lp.items()}, kp[g], vp[g],
+                    self.attn_windows[g])
+                kps.append(kp_g)
+                vps.append(vp_g)
+            return h, (jnp.stack(kps), jnp.stack(vps))
+
+        lps = {k: params[k] for k in self._block_keys()}
+        ck, cv = pool["k"], pool["v"]
         if p > 1:
             lps = _period_group(lps, p)
             ck = _period_group(ck, p)

@@ -11,13 +11,18 @@ vLLM-style paged layout:
   **trash page**: its refcount is pinned to 1, unallocated block-table
   cells point at it, and dead/parked rows' garbage writes land there.
 * **Block tables** ``[S, M]`` int32 — per-slot maps from logical page
-  index to LOCAL physical page id. Attention reads through the table via
-  :func:`~elephas_tpu.models.transformer.paged_gather_view`, which
-  materializes a dense per-slot view whose TIME AXIS EQUALS THE DENSE
-  CAPACITY — so the existing decode/chunk kernels run unchanged on the
-  view and their attention reductions group identically to the dense
-  path. That is the bit-identity contract, and it is why ``page`` must
-  divide the per-shard cache length.
+  index to LOCAL physical page id. Attention reads through the table
+  DIRECTLY: the fused paged kernels
+  (:mod:`~elephas_tpu.ops.paged_attention`, wired through
+  ``TransformerLM.decode_step_paged`` / ``decode_chunk_paged``) stream
+  K/V pages out of the pool via block index maps dereferencing the
+  table, and each layer scatters only the NEWLY PRODUCED rows into their
+  owning pages — O(new tokens) traffic, no dense-layout round trip. On
+  CPU the reference path gathers a transient per-slot view whose time
+  axis equals the dense capacity and applies the exact dense attention
+  math, so its reductions group identically to the dense path. That is
+  the bit-identity contract, and it is why ``page`` must divide the
+  per-shard cache length.
 * **Refcounts + radix prefix cache** — full prompt pages are registered
   in a radix tree keyed on their token content at page granularity.
   A later request with the same prefix *adopts* the cached pages (pure
@@ -33,8 +38,10 @@ vLLM-style paged layout:
   low-rank deltas inside the very same compiled decode/insert kernels.
 
 Host bookkeeping (refcounts, tables, radix tree) is pure Python; device
-mutation goes through the three compiled kernels below (or the sharded
-programs from ``build_paged_serving_ops``), all of which DONATE the pool.
+mutation goes through the compiled kernels below (or the sharded
+programs from ``build_paged_serving_ops``), all of which DONATE the
+pool. The device block table is resident too: dirty slot ROWS are
+refreshed with a jitted one-row scatter, never a whole-table upload.
 """
 
 from __future__ import annotations
@@ -47,8 +54,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models.transformer import (_adapter_ctx, paged_gather_view,
-                                  paged_scatter_rows, select_slot_tokens,
+from ..models.transformer import (_adapter_ctx, select_slot_tokens,
                                   spec_verify_select)
 from ..ops.flash_decode import aligned_cache_length
 from .cache import bucket_length
@@ -256,143 +262,114 @@ class RadixPrefixCache:
 @partial(jax.jit, static_argnames=("model", "page"), donate_argnums=(3,))
 def _paged_insert_kernel(model, page, params, pool, table, slot, tokens,
                          t_last, pos0, aid):
-    """Paged prefill-insert: gather slot ``slot``'s dense view through its
-    block-table row, run the ordinary ``decode_chunk`` on it (adapter
-    deltas applied when the model is multi-tenant), and scatter the WHOLE
-    row of pages back. Rewriting already-shared prefix pages is a bitwise
-    no-op (the view carried their bytes through unchanged); duplicate
-    trash ids in the row make the trash write undefined-pick, which is
-    fine because trash is never read unmasked. Keyed on (model, page, Tb);
-    the pool is donated."""
+    """Paged prefill-insert, fused: run ``decode_chunk_paged`` for slot
+    ``slot`` DIRECTLY over the pool through its block-table row — each
+    layer scatters only the chunk's own K/V rows into their owning pages
+    (adopted prefix pages are attended through the table, never
+    rewritten) and no dense view is materialized. Adapter deltas apply
+    when the model is multi-tenant. Bucket-padding positions past the
+    prompt write finite garbage into the owned tail page (or the trash
+    page when unmapped), exactly the stale-dead rows the dense path
+    leaves — decode overwrites them before anything attends. Keyed on
+    (model, page, Tb); the pool is donated."""
     M = table.shape[1]
     trow = jax.lax.dynamic_slice(table, (slot, 0), (1, M))     # [1, M]
-    view = {n: paged_gather_view(pool[n], trow, page) for n in ("k", "v")}
     with _adapter_ctx(model, jnp.reshape(aid, (1,))):
-        logits, view = model.decode_chunk(params, tokens, pos0, view)
+        logits, pool = model.decode_chunk_paged(params, tokens, pos0,
+                                                pool, trow, page)
     last = jax.lax.dynamic_index_in_dim(logits[0], t_last, axis=0,
                                         keepdims=False)
-    L, _, Hkv, _, Dh = pool["k"].shape
-    new_pool = {}
-    for n in ("k", "v"):
-        vals = view[n][:, 0].reshape(L, Hkv, M, page, Dh)
-        vals = vals.transpose(0, 2, 1, 3, 4)                   # [L,M,Hkv,pg,Dh]
-        new_pool[n] = pool[n].at[:, trow[0]].set(vals, mode="drop")
-    return last, new_pool
+    return last, pool
 
 
 @partial(jax.jit, static_argnames=("model", "page"), donate_argnums=(3,))
 def _paged_decode_kernel(model, page, params, pool, table, aids, tokens,
                          pos, temps, keys, live):
-    """One batched decode step over the paged pool: gather every slot's
-    dense view, run the ordinary batched ``decode_step`` + per-slot
-    selection, then scatter back ONLY the one time-row each slot wrote.
-    Slots whose table cell at the write position is unmapped (freed rows,
-    chunk-parked rows at a page boundary) scatter into the trash page;
-    parked rows mid-page overwrite their own write-head garbage exactly
-    like the dense path, repaired by the next chunk before it is read."""
-    view = {n: paged_gather_view(pool[n], table, page) for n in ("k", "v")}
+    """One batched decode step DIRECTLY over the paged pool: every layer
+    of ``decode_step_paged`` scatters exactly one new K/V row per slot
+    into its owning page (O(new tokens) traffic) and attends through the
+    block table with the fused paged kernel — the old per-step
+    gather-to-dense/scatter-back round trip is gone. Slots whose table
+    cell at the write position is unmapped (freed rows, chunk-parked rows
+    at a page boundary) write into the trash page; parked rows mid-page
+    overwrite their own write-head garbage exactly like the dense path,
+    repaired by the next chunk before it is read."""
     with _adapter_ctx(model, aids):
-        logits, view = model.decode_step(params, tokens, pos, view)
+        logits, pool = model.decode_step_paged(params, tokens, pos, pool,
+                                               table, page)
     emit = select_slot_tokens(logits, pos + 1, temps, keys)
-    pids = jnp.take_along_axis(table, (pos // page)[:, None], axis=1)[:, 0]
-    offs = pos % page
-    new_pool = {}
-    for n in ("k", "v"):
-        rows = jnp.take_along_axis(
-            view[n], pos[None, :, None, None, None], axis=3)[:, :, :, 0]
-        new_pool[n] = paged_scatter_rows(pool[n], rows, pids, offs)
     tokens = jnp.where(live, emit, tokens)
     pos = jnp.where(live, pos + 1, pos)
-    return emit, tokens, pos, new_pool
+    return emit, tokens, pos, pool
 
 
 @partial(jax.jit, static_argnames=("model", "page", "n_steps"),
          donate_argnums=(4,))
 def _paged_fused_kernel(model, page, n_steps, params, pool, table, aids,
                         tokens, pos, temps, keys, live):
-    """``n_steps`` paged decode steps in ONE program: gather the dense
-    views once, scan the single-step body over them (writes accumulate in
-    the carried VIEWS), then scatter all ``S × n_steps`` written rows back
-    in one flattened scatter. Positions use the ORIGINAL pre-scan ``pos``
-    (non-live rows repeat their write head: duplicate coordinates carry
-    identical final-view values, so any winner is correct). Token-identical
-    to ``n_steps`` single-step launches."""
-    view = {n: paged_gather_view(pool[n], table, page) for n in ("k", "v")}
-
+    """``n_steps`` paged decode steps in ONE program: scan the single-step
+    paged body with the POOL ITSELF as carry — each step's layers write
+    their one new K/V row per slot straight into the owning page, so the
+    whole window moves O(S · n_steps) rows and never materializes a dense
+    view. Non-live rows re-write their own write head (or trash) each
+    step, which is idempotent garbage the position mask never shows.
+    Token-identical to ``n_steps`` single-step launches."""
     def body(carry, _):
-        tok, p, vk, vv = carry
+        tok, p, pk, pv = carry
         with _adapter_ctx(model, aids):
-            logits, v = model.decode_step(params, tok, p, {"k": vk, "v": vv})
+            logits, new = model.decode_step_paged(
+                params, tok, p, {"k": pk, "v": pv}, table, page)
         emit = select_slot_tokens(logits, p + 1, temps, keys)
         tok = jnp.where(live, emit, tok)
         p = jnp.where(live, p + 1, p)
-        return (tok, p, v["k"], v["v"]), emit
+        return (tok, p, new["k"], new["v"]), emit
 
-    (tokens_out, pos_out, vk, vv), emitted = jax.lax.scan(
-        body, (tokens, pos, view["k"], view["v"]), None, length=n_steps)
-
-    cap = view["k"].shape[3]
-    steps = jnp.arange(n_steps)
-    posj = jnp.where(live[:, None], pos[:, None] + steps[None, :],
-                     pos[:, None])                             # [S, K]
-    idx = jnp.clip(posj, 0, cap - 1)
-    pids = jnp.take_along_axis(table, idx // page, axis=1)     # [S, K]
-    offs = idx % page
-    S, K = idx.shape
-    new_pool = {}
-    for n, v in (("k", vk), ("v", vv)):
-        rows = jnp.take_along_axis(
-            v, idx[None, :, None, :, None], axis=3)            # [L,S,Hkv,K,Dh]
-        rows = rows.transpose(0, 1, 3, 2, 4).reshape(
-            rows.shape[0], S * K, rows.shape[2], rows.shape[4])
-        new_pool[n] = paged_scatter_rows(pool[n], rows,
-                                         pids.reshape(S * K),
-                                         offs.reshape(S * K))
-    return emitted.T, tokens_out, pos_out, new_pool
+    (tokens, pos, pk, pv), emitted = jax.lax.scan(
+        body, (tokens, pos, pool["k"], pool["v"]), None, length=n_steps)
+    return emitted.T, tokens, pos, {"k": pk, "v": pv}
 
 
 @partial(jax.jit, static_argnames=("model", "page"), donate_argnums=(3,))
 def _paged_verify_kernel(model, page, params, pool, table, aids, drafts,
                          tokens, pos, temps, keys, live):
-    """Speculative verify over the paged pool, ONE program: gather every
-    slot's dense view, score carry + ``W`` drafts as a ``decode_chunk``
-    under each row's adapter, accept with the exact-match rule
-    (:func:`~elephas_tpu.models.transformer.spec_verify_select`), and
-    scatter back ONLY the accepted run's K/V rows — the rejected tail
-    (and every non-live row) is MASKED INTO THE TRASH PAGE, so no page
-    churn, copy-on-write, or content divergence leaks from rejected
-    tokens. An accepted position's page bytes are bitwise what a
-    sequential decode would have written there (same view, same inputs),
-    which is what keeps paged ≡ dense under speculation even though the
-    dense path leaves rejected K/V in place as stale-dead rows."""
-    view = {n: paged_gather_view(pool[n], table, page) for n in ("k", "v")}
+    """Speculative verify DIRECTLY over the paged pool, ONE program:
+    score carry + ``W`` drafts as a ``decode_chunk_paged`` under each
+    row's adapter and accept with the exact-match rule
+    (:func:`~elephas_tpu.models.transformer.spec_verify_select`). The
+    FULL chunk's K/V — rejected tail included — lands in the slot's own
+    pages, mirroring the dense path's stale-dead rows. That is safe
+    because pages covering decode-era positions are never registered in
+    the prefix cache (``register_prefix`` publishes full PROMPT pages
+    only, at insert time), so no other slot can observe the rejected
+    bytes, and the staleness-repair invariant
+    (:meth:`~elephas_tpu.models.transformer.TransformerLM.generate_speculative`)
+    rewrites every position past the accepted run before anything attends
+    it. An accepted position's page bytes are bitwise what a sequential
+    decode would have written there (same pool, same inputs), which is
+    what keeps paged ≡ dense under speculation."""
     chunk = jnp.concatenate([tokens[:, None], drafts], axis=1)   # [S, C]
     with _adapter_ctx(model, aids):
-        logits, view = model.decode_chunk(params, chunk, pos, view)
+        logits, pool = model.decode_chunk_paged(params, chunk, pos, pool,
+                                                table, page)
     sel, n_acc = spec_verify_select(logits, drafts, pos, temps, keys)
     corr = jnp.take_along_axis(sel, n_acc[:, None], axis=1)[:, 0]
-    S, C = chunk.shape
-    cap = view["k"].shape[3]
-    steps = jnp.arange(C)
-    posj = jnp.where(live[:, None], pos[:, None] + steps[None, :],
-                     pos[:, None])                              # [S, C]
-    idx = jnp.clip(posj, 0, cap - 1)
-    keep = live[:, None] & (steps[None, :] <= n_acc[:, None])
-    pids = jnp.where(keep,
-                     jnp.take_along_axis(table, idx // page, axis=1), 0)
-    offs = idx % page
-    new_pool = {}
-    for n in ("k", "v"):
-        rows = jnp.take_along_axis(
-            view[n], idx[None, :, None, :, None], axis=3)       # [L,S,Hkv,C,Dh]
-        rows = rows.transpose(0, 1, 3, 2, 4).reshape(
-            rows.shape[0], S * C, rows.shape[2], rows.shape[4])
-        new_pool[n] = paged_scatter_rows(pool[n], rows,
-                                         pids.reshape(S * C),
-                                         offs.reshape(S * C))
     tokens = jnp.where(live, corr, tokens)
     pos = jnp.where(live, pos + n_acc + 1, pos)
-    return sel, n_acc, tokens, pos, new_pool
+    return sel, n_acc, tokens, pos, pool
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_table_row(table_dev, slot, row):
+    """Refresh ONE slot's block-table row in the device-resident table
+    (donated in place) — the steady-state alternative to re-uploading the
+    whole ``[S, M]`` host table every launch."""
+    return table_dev.at[slot].set(row)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_aids_row(aids_dev, slot, aid):
+    """Refresh one slot's adapter id in the device-resident vector."""
+    return aids_dev.at[slot].set(aid)
 
 
 class PagedKVCache:
@@ -401,8 +378,11 @@ class PagedKVCache:
     surface the engine drives, plus page bookkeeping (``_ensure_span`` /
     ``ensure_decode``), prefix adoption/registration, eviction, admission
     accounting, and engine-signature ``decode_fn``/``fused_fn`` wrappers
-    that fetch the device table/adapter-id arrays themselves (host copies
-    are cached behind dirty flags — decode steps re-upload nothing).
+    that fetch the device table/adapter-id arrays themselves. The device
+    copies are RESIDENT across steps: host bookkeeping marks individual
+    slot ROWS dirty, and each launch refreshes just those rows with a
+    jitted donate-in-place scatter — steady-state decode uploads nothing,
+    admissions/releases upload ``O(M)`` ints, never the whole table.
 
     ``pages_per_partition`` defaults to the dense-equivalent pool
     (``n_slots_local × pages_per_slot + trash``), where paged-vs-dense
@@ -476,8 +456,8 @@ class PagedKVCache:
         self._free: List[int] = list(range(S - 1, -1, -1))
         self._table_dev = None
         self._aids_dev = None
-        self._table_dirty = True
-        self._aids_dirty = True
+        self._table_rows_dirty: set = set()
+        self._aids_rows_dirty: set = set()
         self.preemptions = 0
         self._prefix_hits = 0
         self._prefix_lookups = 0
@@ -505,8 +485,8 @@ class PagedKVCache:
         self.table[slot, :] = 0
         self.aids[slot] = 0
         self.pos[slot] = 0
-        self._table_dirty = True
-        self._aids_dirty = True
+        self._table_rows_dirty.add(slot)
+        self._aids_rows_dirty.add(slot)
         self._free.append(slot)
 
     def advance(self, slot: int) -> None:
@@ -523,7 +503,7 @@ class PagedKVCache:
 
     def set_adapter(self, slot: int, adapter_id: int) -> None:
         self.aids[slot] = int(adapter_id)
-        self._aids_dirty = True
+        self._aids_rows_dirty.add(slot)
 
     def _ensure_span(self, slot: int, lo: int, hi: int) -> None:
         """Allocate (idempotently) every page covering positions
@@ -538,7 +518,7 @@ class PagedKVCache:
                 lid = self.allocator.alloc(part)
                 self.owned[slot][m] = (part, lid)
                 self.table[slot, m] = lid
-                self._table_dirty = True
+                self._table_rows_dirty.add(slot)
 
     def ensure_decode(self, slots, n_steps: int) -> None:
         """Allocate the pages the next ``n_steps`` decode writes of each
@@ -567,7 +547,7 @@ class PagedKVCache:
             self.allocator.incref(node.partition, node.lid)
             self.owned[slot][m] = (node.partition, node.lid)
             self.table[slot, m] = node.lid
-            self._table_dirty = True
+            self._table_rows_dirty.add(slot)
         return len(chain) * self.page
 
     def register_prefix(self, slot: int, prompt) -> int:
@@ -704,20 +684,38 @@ class PagedKVCache:
         return last
 
     def _device_tables(self):
-        """Current device block table + adapter ids, re-uploaded only when
-        host bookkeeping dirtied them (decode-only steps upload nothing)."""
-        if self._table_dirty or self._table_dev is None:
+        """Current device block table + adapter ids. Both stay RESIDENT on
+        device: the first call uploads them whole, after which dirty slot
+        rows (admission, release, page growth, adapter swap) are patched
+        in place with a jitted one-row scatter — a steady-state decode
+        step uploads nothing, and no launch ever re-uploads the full
+        ``[S, M]`` host table again."""
+        if self._table_dev is None:
             if self._ops is not None:
                 self._table_dev = self._ops.upload_table(self.table)
             else:
                 self._table_dev = jnp.asarray(self.table)
-            self._table_dirty = False
-        if self._aids_dirty or self._aids_dev is None:
+            self._table_rows_dirty.clear()
+        elif self._table_rows_dirty:
+            scatter = (self._ops.scatter_table_row
+                       if self._ops is not None else _scatter_table_row)
+            for s in sorted(self._table_rows_dirty):
+                self._table_dev = scatter(self._table_dev, jnp.int32(s),
+                                          jnp.asarray(self.table[s]))
+            self._table_rows_dirty.clear()
+        if self._aids_dev is None:
             if self._ops is not None:
                 self._aids_dev = self._ops.upload_aids(self.aids)
             else:
                 self._aids_dev = jnp.asarray(self.aids)
-            self._aids_dirty = False
+            self._aids_rows_dirty.clear()
+        elif self._aids_rows_dirty:
+            scatter = (self._ops.scatter_aids_row
+                       if self._ops is not None else _scatter_aids_row)
+            for s in sorted(self._aids_rows_dirty):
+                self._aids_dev = scatter(self._aids_dev, jnp.int32(s),
+                                         jnp.int32(self.aids[s]))
+            self._aids_rows_dirty.clear()
         return self._table_dev, self._aids_dev
 
     def decode_fn(self, params, cache, tokens, pos, temps, keys, live):
@@ -767,6 +765,9 @@ class PagedKVCache:
         used = total - free
         k = self.cache["k"]
         bytes_ = 2 * int(np.prod(k.shape)) * k.dtype.itemsize
+        L, _, Hkv, _, Dh = k.shape
+        # one K+V time-row: the ONLY per-token copy the fused kernels pay
+        row_bytes = 2 * L * Hkv * Dh * k.dtype.itemsize
         return {
             "page_size": self.page,
             "pages_per_partition": self.pages_per_partition,
@@ -776,6 +777,12 @@ class PagedKVCache:
             "pages_free": free,
             "page_utilization": used / total if total else 0.0,
             "kv_hbm_bytes": bytes_,
+            # gather/scatter traffic accounting (per slot): the fused
+            # paged kernels scatter one new K/V row per produced token;
+            # the retired gather-to-dense round trip moved the slot's
+            # whole capacity through HBM each step and scattered it back
+            "copy_bytes_per_token": row_bytes,
+            "copy_bytes_per_step_gathered": row_bytes * (self.capacity + 1),
             "preemptions": self.preemptions,
             "prefix": {
                 "nodes": self.prefix.n_nodes if self.prefix else 0,

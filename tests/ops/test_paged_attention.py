@@ -1,0 +1,264 @@
+"""Fused paged attention: the kernel family that decodes DIRECTLY over
+the page pool through the block table (``ops/paged_attention.py``).
+
+Three layers of pins:
+
+* the jnp reference oracle (gather-through-table + the exact dense
+  reference math) must be BITWISE the dense kernels on the equivalent
+  dense cache — this is what makes the CPU paged path bit-identical to
+  the dense serving engine;
+* the Pallas kernels under ``interpret=True`` must match the oracle to
+  float32 accumulation tolerance, and must NEVER read pages wholly past
+  ``pos`` (NaN-poison proof — the pages are simply not DMA'd);
+* ``TransformerLM.decode_step_paged``/``decode_chunk_paged`` must emit
+  logits bitwise equal to dense ``decode_step``/``decode_chunk`` while
+  writing only the newly produced rows into their owning pages.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from elephas_tpu.models.transformer import TransformerLM
+from elephas_tpu.ops.flash_decode import (
+    decode_attention_reference,
+    decode_attention_reference_lse,
+)
+from elephas_tpu.ops.paged_attention import (
+    paged_chunk_reference,
+    paged_decode_reference,
+    paged_decode_reference_lse,
+    paged_flash_chunk,
+    paged_flash_decode_lse,
+    paged_view_rows,
+)
+
+pytestmark = pytest.mark.paged
+
+S, Hkv, G, Dh = 3, 2, 2, 16
+PAGE, M = 8, 5
+P = S * M + 1          # distinct page per (slot, logical index) + trash
+T = M * PAGE
+
+
+def _setup(seed=0, trash=7.25):
+    """Pool + table + the equivalent dense cache. ``trash`` poisons the
+    trash page with finite garbage (the masking contract: trash content
+    is arbitrary but FINITE, and masked contributions are exactly 0)."""
+    rng = np.random.default_rng(seed)
+    kp = rng.standard_normal((P, Hkv, PAGE, Dh)).astype(np.float32)
+    vp = rng.standard_normal((P, Hkv, PAGE, Dh)).astype(np.float32)
+    kp[0] = vp[0] = trash
+    table = 1 + np.arange(S * M, dtype=np.int32).reshape(S, M)
+    # dense cache = the gathered view (gather is pure indexing)
+    tbl = jnp.asarray(table)
+    kd = paged_view_rows(jnp.asarray(kp), tbl, PAGE)
+    vd = paged_view_rows(jnp.asarray(vp), tbl, PAGE)
+    q = rng.standard_normal((S, Hkv, G, Dh)).astype(np.float32)
+    return (jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp), tbl,
+            kd, vd)
+
+
+@pytest.mark.parametrize("window", [None, 11])
+def test_decode_oracle_bitwise_vs_dense(window):
+    q, kp, vp, table, kd, vd = _setup()
+    pos = jnp.asarray([5, 17, T - 1], jnp.int32)   # mid-page, page edge
+    o_ref, lse_ref = decode_attention_reference_lse(q, kd, vd, pos,
+                                                    window=window)
+    o_pag, lse_pag = paged_decode_reference_lse(q, kp, vp, table, pos,
+                                                PAGE, window=window)
+    assert (np.asarray(o_ref) == np.asarray(o_pag)).all()
+    assert (np.asarray(lse_ref) == np.asarray(lse_pag)).all()
+    o2 = paged_decode_reference(q, kp, vp, table, pos, PAGE, window=window)
+    assert (np.asarray(o_ref) == np.asarray(o2)).all()
+
+
+@pytest.mark.parametrize("window", [None, 11])
+def test_chunk_oracle_bitwise_vs_dense_chunk_math(window):
+    """The chunk oracle must reproduce ``decode_chunk``'s exact einsum/
+    softmax block (that block is re-derived here verbatim)."""
+    import jax
+    rng = np.random.default_rng(1)
+    C = 4
+    q = jnp.asarray(
+        rng.standard_normal((S, Hkv, G, C, Dh)).astype(np.float32))
+    _, kp, vp, table, kd, vd = _setup(seed=1)
+    pos0 = jnp.asarray([3, 14, 26], jnp.int32)
+    pos_b = pos0[:, None] + jnp.arange(C)[None, :]
+    slots = jnp.arange(T)[None, None, :]
+    m = slots <= pos_b[:, :, None]
+    if window is not None:
+        m &= slots > pos_b[:, :, None] - window
+    scores = jnp.einsum(
+        "bkgsd,bktd->bkgst", q, kd,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST) * (Dh ** -0.5)
+    scores = jnp.where(m[:, None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    want = jnp.einsum(
+        "bkgst,bktd->bkgsd", probs, vd,
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST)
+    got = paged_chunk_reference(q, kp, vp, table, pos0, PAGE,
+                                window=window)
+    assert (np.asarray(want) == np.asarray(got)).all()
+
+
+@pytest.mark.parametrize("window", [None, 11])
+def test_pallas_decode_interpret_matches_oracle(window):
+    q, kp, vp, table, _, _ = _setup(seed=2)
+    pos = jnp.asarray([7, 12, 31], jnp.int32)
+    o_ref, lse_ref = paged_decode_reference_lse(q, kp, vp, table, pos,
+                                                PAGE, window=window)
+    o_ker, lse_ker = paged_flash_decode_lse(q, kp, vp, table, pos, PAGE,
+                                            window=window, interpret=True)
+    np.testing.assert_allclose(np.asarray(o_ker), np.asarray(o_ref),
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(lse_ker), np.asarray(lse_ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+@pytest.mark.parametrize("window", [None, 9])
+def test_pallas_chunk_interpret_matches_oracle(window):
+    rng = np.random.default_rng(3)
+    C = 4
+    q = jnp.asarray(
+        rng.standard_normal((S, Hkv, G, C, Dh)).astype(np.float32))
+    _, kp, vp, table, _, _ = _setup(seed=3)
+    pos0 = jnp.asarray([6, 13, 22], jnp.int32)   # 6+3 straddles page 1
+    want = paged_chunk_reference(q, kp, vp, table, pos0, PAGE,
+                                 window=window)
+    got = paged_flash_chunk(q, kp, vp, table, pos0, PAGE, window=window,
+                            interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_pallas_never_reads_pages_past_pos():
+    """Pages wholly past ``pos`` are never DMA'd: poisoning them with NaN
+    must not perturb the output (the oracle masks them; the kernel's
+    block index map never touches them)."""
+    q, kp, vp, table, _, _ = _setup(seed=4)
+    pos = jnp.asarray([5, 9, 12], jnp.int32)     # pages >= 2 dead for all
+    clean_o, clean_l = paged_flash_decode_lse(q, kp, vp, table, pos, PAGE,
+                                              interpret=True)
+    kp_n, vp_n = np.asarray(kp).copy(), np.asarray(vp).copy()
+    for s in range(S):
+        for mcell in range(2, M):                # wholly past every pos
+            kp_n[int(table[s, mcell])] = np.nan
+            vp_n[int(table[s, mcell])] = np.nan
+    pois_o, pois_l = paged_flash_decode_lse(
+        q, jnp.asarray(kp_n), jnp.asarray(vp_n), table, pos, PAGE,
+        interpret=True)
+    assert np.isfinite(np.asarray(pois_o)).all()
+    assert (np.asarray(clean_o) == np.asarray(pois_o)).all()
+    assert (np.asarray(clean_l) == np.asarray(pois_l)).all()
+
+
+def test_trash_page_masked_exactly():
+    """Unmapped table cells (trash, id 0) within the visible range must
+    contribute exactly zero: finite trash garbage × exp(-inf) = 0."""
+    q, kp, vp, table, kd, vd = _setup(seed=5, trash=1e4)
+    pos = jnp.asarray([4, 4, 4], jnp.int32)
+    want = decode_attention_reference(q, kd, vd, pos)
+    got = paged_decode_reference(q, kp, vp, table, pos, PAGE)
+    assert (np.asarray(want) == np.asarray(got)).all()
+
+
+class TestTransformerPagedMethods:
+    """decode_step_paged / decode_chunk_paged vs their dense siblings on
+    a real model: logits AND written-KV bitwise identity."""
+
+    def _mk(self, **kw):
+        cfg = dict(vocab=17, d_model=16, n_heads=4, n_layers=2, d_ff=32,
+                   max_len=64)
+        cfg.update(kw)
+        model = TransformerLM(**cfg)
+        params = model.init(0)
+        return model, params
+
+    def _pools(self, model, B, M_):
+        L = model.n_layers
+        hkv = model.n_kv_heads
+        dh = model.d_model // model.n_heads
+        T_ = M_ * PAGE
+        cache = {k: jnp.zeros((L, B, hkv, T_, dh), jnp.float32)
+                 for k in ("k", "v")}
+        pool = {k: jnp.full((L, B * M_ + 1, hkv, PAGE, dh), 7.25,
+                            jnp.float32) for k in ("k", "v")}
+        table = jnp.asarray(1 + np.arange(B * M_).reshape(B, M_),
+                            jnp.int32)
+        return cache, pool, table
+
+    @pytest.mark.parametrize("windows", [None, (None, 8)])
+    def test_bitwise_identity_chunk_then_steps(self, windows):
+        kw = {} if windows is None else {"attn_window": windows}
+        model, params = self._mk(**kw)
+        rng = np.random.default_rng(0)
+        B, M_ = 3, 6
+        cache, pool, table = self._pools(model, B, M_)
+        toks = jnp.asarray(rng.integers(0, 17, (B, 11)), jnp.int32)
+        lg_d, cache = model.decode_chunk(params, toks, 0, cache)
+        lg_p, pool = model.decode_chunk_paged(params, toks, 0, pool,
+                                              table, PAGE)
+        assert (np.asarray(lg_d) == np.asarray(lg_p)).all()
+        # written KV bytes identical through the gathered view
+        for key in ("k", "v"):
+            for l in range(model.n_layers):
+                view = paged_view_rows(pool[key][l], table, PAGE)
+                assert (np.asarray(cache[key][l][:, :, :11])
+                        == np.asarray(view[:, :, :11])).all()
+        pos = jnp.full((B,), 11, jnp.int32)
+        for step in range(8):                    # crosses page boundary
+            tok = jnp.asarray(rng.integers(0, 17, (B,)), jnp.int32)
+            lg_d, cache = model.decode_step(params, tok, pos, cache)
+            lg_p, pool = model.decode_step_paged(params, tok, pos, pool,
+                                                 table, PAGE)
+            assert (np.asarray(lg_d) == np.asarray(lg_p)).all(), step
+            pos = pos + 1
+
+    def test_per_row_verify_chunk_bitwise(self):
+        model, params = self._mk()
+        rng = np.random.default_rng(1)
+        B, M_ = 3, 6
+        cache, pool, table = self._pools(model, B, M_)
+        toks = jnp.asarray(rng.integers(0, 17, (B, 9)), jnp.int32)
+        _, cache = model.decode_chunk(params, toks, 0, cache)
+        _, pool = model.decode_chunk_paged(params, toks, 0, pool, table,
+                                           PAGE)
+        pos0 = jnp.asarray([9, 7, 8], jnp.int32)  # uneven (spec verify)
+        ch = jnp.asarray(rng.integers(0, 17, (B, 5)), jnp.int32)
+        lg_d, _ = model.decode_chunk(params, ch, pos0, cache)
+        lg_p, _ = model.decode_chunk_paged(params, ch, pos0, pool, table,
+                                           PAGE)
+        assert (np.asarray(lg_d) == np.asarray(lg_p)).all()
+
+    def test_unmapped_write_lands_in_trash(self):
+        """Positions past the table's capacity write to the trash page
+        and never corrupt mapped pages."""
+        model, params = self._mk()
+        rng = np.random.default_rng(2)
+        B, M_ = 3, 6
+        _, pool, table = self._pools(model, B, M_)
+        toks = jnp.asarray(rng.integers(0, 17, (B, 9)), jnp.int32)
+        _, pool = model.decode_chunk_paged(params, toks, 0, pool, table,
+                                           PAGE)
+        tbl2 = table[:, :2]                      # capacity 16
+        tok = jnp.asarray(rng.integers(0, 17, (B,)), jnp.int32)
+        over = jnp.full((B,), 20, jnp.int32)
+        _, pool2 = model.decode_step_paged(params, tok, over, pool, tbl2,
+                                           PAGE)
+        for key in ("k", "v"):
+            assert (np.asarray(pool2[key][:, 1:])
+                    == np.asarray(pool[key][:, 1:])).all()
+
+    def test_ring_cache_refused(self):
+        model, params = self._mk(attn_window=8)  # all-windowed → rolling
+        _, pool, table = self._pools(model, 2, 4)
+        tok = jnp.zeros((2,), jnp.int32)
+        with pytest.raises(ValueError, match="linear-horizon"):
+            model.decode_step_paged(params, tok, 0, pool, table, PAGE)
+        with pytest.raises(ValueError, match="linear-horizon"):
+            model.decode_chunk_paged(params, tok[:, None], 0, pool,
+                                     table, PAGE)

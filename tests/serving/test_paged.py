@@ -19,7 +19,7 @@ from elephas_tpu.models.transformer import TransformerLM, build_mesh_sp
 from elephas_tpu.resilience import FaultPlan
 from elephas_tpu.serving import AdmissionError, ServingEngine
 
-pytestmark = pytest.mark.serving
+pytestmark = [pytest.mark.serving, pytest.mark.paged]
 
 V = 17
 

@@ -1,0 +1,223 @@
+"""Seeded dense-vs-paged identity fuzz and page-pressure chaos.
+
+The paged engine's ONE contract is bitwise token identity with the
+dense engine under every knob combination.  This file sweeps the knob
+cross-product — ``(page_size, fuse_k, speculate_k, prefill_chunk)``,
+plus multi-tenant adapter routing — with ``kv.check()`` asserted after
+EVERY engine step, not just at drain.  It also pins the two paged-only
+hazards the sweep alone can't force:
+
+* speculative accept runs that STRADDLE a page boundary (``page_size=8``
+  with ``speculate_k=5`` — a fully-accepted verify chunk commits 5
+  tokens, so some round necessarily crosses ``pos % 8 == 0``), and
+* ``PagesExhausted`` raised while a FUSED multi-token window wants
+  pages: clean-leaf eviction, then newest-admitted preemption, then a
+  token-transparent resume of the preempted request.
+
+Every assertion here is exact equality — no tolerances anywhere.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elephas_tpu.models.lora import MultiTenantLM
+from elephas_tpu.models.transformer import TransformerLM
+from elephas_tpu.serving.engine import ServingEngine
+
+pytestmark = [pytest.mark.serving, pytest.mark.paged]
+
+V = 17
+
+
+def _model(**kw):
+    cfg = dict(vocab=V, d_model=16, n_heads=4, n_layers=2, d_ff=32,
+               max_len=64)
+    cfg.update(kw)
+    return TransformerLM(**cfg)
+
+
+def _params(model, seed=1):
+    return {k: jnp.asarray(v) for k, v in model.init(seed=seed).items()}
+
+
+def _prompts(rng, lens):
+    return [rng.integers(0, V, size=(n,)).astype(np.int32) for n in lens]
+
+
+def _run_checked(eng, reqs, *, check_every_step=True, **submit_kw):
+    """Submit ``reqs``, drive the engine ONE step at a time, and assert
+    the allocator invariants (``kv.check()``) after every single step —
+    the fuzz contract is that no intermediate state is ever broken, not
+    merely the final one."""
+    ids = []
+    for i, (prompt, max_new) in enumerate(reqs):
+        ids.append(eng.submit(prompt, max_new, seed=i, **submit_kw))
+        eng.step()
+        if check_every_step and eng.kv is not None:
+            eng.kv.check()
+    for _ in range(5000):
+        if not (eng.scheduler.queue_depth or eng.kv.active_slots):
+            break
+        eng.step()
+        if check_every_step and eng.kv is not None:
+            eng.kv.check()
+    else:  # pragma: no cover - hang guard
+        raise AssertionError("engine did not drain in 5000 steps")
+    return [eng.result(rid).tokens for rid in ids]
+
+
+def _run_dense(model, params, reqs, **submit_kw):
+    return _run_checked(ServingEngine(model, params, n_slots=4), reqs,
+                        check_every_step=False, **submit_kw)
+
+
+# -- knob-sweep fuzz ------------------------------------------------------
+
+# (page_size, fuse_k, speculate_k, prefill_chunk) — each row turns a
+# different subset of the fast-path machinery loose on the page pool.
+# Tier-1 keeps the two ends of the spectrum (plain, and everything at
+# once); the interior rows are `slow` and run via `make test-paged`
+# (the group's `-m paged` is appended after `-m "not slow"`).
+_slow = pytest.mark.slow
+KNOBS = [
+    (8, 1, 1, None),                          # plain single-step decode
+    pytest.param(16, 1, 1, None, marks=_slow),  # bigger pages
+    pytest.param(8, 4, 1, None, marks=_slow),   # fused windows only
+    pytest.param(8, 1, 4, None, marks=_slow),   # speculation only
+    pytest.param(8, 1, 1, 8, marks=_slow),      # chunked prefill only
+    pytest.param(16, 4, 1, 16, marks=_slow),    # fused + chunked, p16
+    (8, 2, 5, 8),        # everything at once; 5-token verify chunks
+]
+
+
+@pytest.mark.parametrize("page,fuse_k,spec_k,chunk", KNOBS)
+@pytest.mark.parametrize("temp", [0.0, 0.8])
+def test_fuzz_knob_sweep_bitwise_identity(page, fuse_k, spec_k, chunk,
+                                          temp):
+    """Every knob combination streams EXACTLY the dense engine's tokens,
+    greedy and sampled, with allocator invariants intact at every step."""
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(1000 + page * 31 + fuse_k * 7
+                                + spec_k * 3 + (chunk or 0))
+    # prompt lengths land on, just before, and well past page boundaries
+    reqs = [(p, 10) for p in _prompts(rng, [5, 16, 23, 7, 31, 9])]
+    want = _run_dense(model, params, reqs, temperature=temp)
+    eng = ServingEngine(model, params, n_slots=4, paged=True,
+                        page_size=page, fuse_k=fuse_k,
+                        speculate_k=spec_k, prefill_chunk=chunk)
+    got = _run_checked(eng, reqs, temperature=temp)
+    assert got == want
+    stats = eng.kv.memory_stats()
+    # all request refs released; at most clean prefix-cache pages remain
+    assert stats["pages_used"] == stats["prefix"]["nodes"]
+    eng.kv.evict_pages(0, stats["pages_total"])
+    assert eng.kv.memory_stats()["pages_used"] == 0
+    eng.kv.check()
+
+
+def test_fuzz_multi_tenant_knob_sweep():
+    """Multi-tenant LoRA routing stays exact under the fast-path knobs:
+    co-batched tenants with different adapters + speculation + chunked
+    prefill each match a dedicated dense engine running that tenant's
+    MERGED weights."""
+    mt = MultiTenantLM(vocab=V, d_model=16, n_heads=4, n_layers=2,
+                       d_ff=32, max_len=64, n_adapters=3, lora_rank=4)
+    mtp = mt.init(seed=1)
+    mtp = mt.randomize_adapter(mtp, 1, seed=7)
+    mtp = mt.randomize_adapter(mtp, 2, seed=8)
+    mtp = {k: jnp.asarray(v) for k, v in mtp.items()}
+    base = mt.base_model()
+    rng = np.random.default_rng(21)
+    prompts = _prompts(rng, [15, 19, 24, 9])
+    eng = ServingEngine(mt, mtp, n_slots=4, paged=True, page_size=8,
+                        speculate_k=4, prefill_chunk=8)
+    ids = [eng.submit(p, 10, seed=0, request_id=f"r{i}", adapter_id=i % 3)
+           for i, p in enumerate(prompts)]
+    for _ in range(5000):
+        if not (eng.scheduler.queue_depth or eng.kv.active_slots):
+            break
+        eng.step()
+        eng.kv.check()
+    for i, (p, rid) in enumerate(zip(prompts, ids)):
+        merged = mt.merged_params(mtp, i % 3)
+        ref = ServingEngine(base, merged, n_slots=1)
+        ref.submit(p, 10, seed=0, request_id="x")
+        ref.drain(max_steps=5000)
+        assert eng.result(rid).tokens == ref.result("x").tokens, i
+    eng.kv.check()
+
+
+# -- page-boundary-straddling speculative accepts ------------------------
+
+def test_spec_accepts_straddle_page_boundaries():
+    """Greedy self-speculation accepts every draft, so each verify round
+    commits ``speculate_k`` tokens at once; with ``speculate_k=5`` and
+    ``page_size=8`` those 5-token runs MUST repeatedly straddle page
+    boundaries (gcd(5, 8) = 1 walks every residue).  The committed
+    stream still equals per-request ``generate`` bitwise, and the new
+    page acquired mid-chunk is accounted exactly at every step."""
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(33)
+    # pos starts at len(prompt); 6 and 7 put the first verify chunk
+    # across the first page edge immediately
+    prompts = _prompts(rng, [6, 7, 14, 23])
+    eng = ServingEngine(model, params, n_slots=4, paged=True, page_size=8,
+                        speculate_k=5)
+    got = _run_checked(eng, [(p, 20) for p in prompts])
+    for i, p in enumerate(prompts):
+        ref = np.asarray(model.generate(params, p[None], 20))
+        assert got[i] == ref[0, len(p):].tolist()
+    # speculation actually ran (the point of the test)
+    fp = eng.snapshot()["fastpath"]
+    assert fp["spec_rounds"] > 0 and fp["spec_accepted"] > 0
+
+
+# -- chaos: PagesExhausted mid-fused-window ------------------------------
+
+def test_chaos_pages_exhausted_mid_fused_window():
+    """A fused K-token window pre-allocates every page it may write; with
+    a pool sized so that allocation FAILS mid-flight, the engine must
+    evict clean leaves, then preempt the newest-admitted request, launch
+    the window for the survivors, and later resume the victim with NO
+    token-level trace — the final streams are bitwise the dense engine's
+    and the pool drains to zero."""
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(6)
+    prompts = _prompts(rng, [21, 19, 23, 17])
+    reqs = [(p, 12) for p in prompts]
+    want = _run_dense(model, params, reqs)
+    eng = ServingEngine(model, params, n_slots=4, paged=True, page_size=8,
+                        fuse_k=4, pages_per_partition=12,
+                        prefix_cache=False)
+    got = _run_checked(eng, reqs)
+    assert got == want
+    assert eng.kv.preemptions > 0            # pressure actually bit
+    fp = eng.snapshot()["fastpath"]
+    assert fp["fused_blocks"] > 0            # and fusion actually ran
+    assert eng.kv.memory_stats()["pages_used"] == 0
+    eng.kv.check()
+
+
+def test_chaos_pages_exhausted_mid_spec_window():
+    """Same pressure story for the SPECULATIVE window: every position a
+    verify chunk may write gets its page before launch, so exhaustion
+    surfaces as eviction/preemption BEFORE the program runs and the
+    committed streams stay bitwise-dense."""
+    model = _model()
+    params = _params(model)
+    rng = np.random.default_rng(14)
+    prompts = _prompts(rng, [21, 19, 23, 17])
+    reqs = [(p, 12) for p in prompts]
+    want = _run_dense(model, params, reqs)
+    eng = ServingEngine(model, params, n_slots=4, paged=True, page_size=8,
+                        speculate_k=4, pages_per_partition=12,
+                        prefix_cache=False)
+    got = _run_checked(eng, reqs)
+    assert got == want
+    assert eng.kv.preemptions > 0
+    assert eng.kv.memory_stats()["pages_used"] == 0
+    eng.kv.check()
