@@ -8,8 +8,8 @@ TEST_ENV = PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
 	XLA_FLAGS=--xla_force_host_platform_device_count=8 KERAS_BACKEND=jax
 
 .PHONY: test test-fast test-chaos test-perf test-spec test-streaming \
-	test-fleet test-elastic test-paged bench bench-serving bench-paged \
-	bench-lm bench-spec bench-fleet bench-elastic
+	test-fleet test-elastic test-paged test-soak bench bench-serving \
+	bench-paged bench-lm bench-spec bench-fleet bench-elastic bench-wire
 
 test:
 	$(TEST_ENV) bash scripts/run_tests.sh -x -q
@@ -56,8 +56,24 @@ test-elastic:
 test-paged:
 	ELEPHAS_TEST_GROUP=paged $(TEST_ENV) bash scripts/run_tests.sh -x -q
 
+# Randomized cross-stack chaos soak, including the slow >=20-schedule
+# acceptance run (seeded fault schedules over ALL sites — wire corruption
+# + logical drops/kills — applied to sync/async/hogwild fit, fit_stream,
+# and a fleet replay, with the global invariant checker after every run).
+# The fast smoke + harness pins also carry the marker and run in tier-1.
+test-soak:
+	ELEPHAS_TEST_GROUP=soak $(TEST_ENV) bash scripts/run_tests.sh -x -q
+
 bench:
 	KERAS_BACKEND=jax python bench.py
+
+# Wire bench only: checksummed v2 framing tax vs the legacy ASCII dialect
+# on a live socket PS push/pull round-trip with multi-MB payloads
+# (acceptance: overhead <= 5%; out-of-band zero-copy framing keeps v2
+# ahead of legacy despite the CRC32C pass).
+bench-wire:
+	JAX_PLATFORMS=cpu KERAS_BACKEND=jax python -c "import json, bench; \
+	print(json.dumps({'wire': bench.bench_wire(3)}))"
 
 # Serving benches only: continuous batching vs sequential, then the fast
 # path (fused K-step decode vs single-step) at concurrency 1 and 8.

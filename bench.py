@@ -1582,6 +1582,89 @@ def bench_elasticity(reps: int):
     }
 
 
+def bench_wire(reps: int):
+    """Checksummed v2 framing tax on the socket parameter-server hot path.
+
+    CPU-runnable. The wire-robustness work (ISSUE 20) moved every socket
+    frame onto a magic+CRC32+bounded-length format; the judged question is
+    what that integrity check costs a real push/pull round-trip. Against
+    ONE live SocketServer, the same multi-MB delta is pushed and the full
+    weights pulled back, alternating a v2-negotiated client against a
+    forced-legacy (``wire_version=1``) client — same process, same server,
+    same payload, interleaved so machine noise hits both sides equally.
+    Both requests ride one connection, so the pull's reply also serializes
+    behind the push (the fire-and-forget push is thereby included in the
+    timed round-trip). Reports the overhead fraction; acceptance is <=5%.
+    Skip with BENCH_WIRE=0; size via BENCH_WIRE_{MB,ROUNDTRIPS}.
+    """
+    import numpy as np
+
+    if os.environ.get("BENCH_WIRE", "1") == "0":
+        log("wire bench: skipped (BENCH_WIRE=0)")
+        return None
+
+    from elephas_tpu.parameter.client import SocketClient
+    from elephas_tpu.parameter.server import SocketServer
+    from elephas_tpu.utils.sockets import WIRE_V1, WIRE_V2
+
+    mb = float(os.environ.get("BENCH_WIRE_MB", 8))
+    roundtrips = int(os.environ.get("BENCH_WIRE_ROUNDTRIPS", 12))
+    side = max(64, int((mb * (1 << 20) / 4 / 2) ** 0.5))
+    weights = [np.zeros((side, side), np.float32),
+               np.ones((side, side), np.float32)]
+    delta = [np.full((side, side), 1e-6, np.float32) for _ in range(2)]
+    payload_mb = sum(a.nbytes for a in weights) / (1 << 20)
+
+    server = SocketServer(weights, mode="asynchronous", port=0)
+    server.start()
+    try:
+        def timed(version):
+            client = SocketClient(port=server.port, host="127.0.0.1",
+                                  timeout=30.0, wire_version=version)
+            try:
+                client.update_parameters(delta)   # warmup: connect+negotiate
+                client.get_parameters()
+                t0 = time.perf_counter()
+                for _ in range(roundtrips):
+                    client.update_parameters(delta)
+                    client.get_parameters()
+                dt = time.perf_counter() - t0
+                negotiated = client.negotiated_wire_version
+            finally:
+                client.close()
+            if negotiated != version:
+                raise RuntimeError(
+                    f"wire bench: negotiated v{negotiated}, wanted "
+                    f"v{version} — the comparison is void")
+            return dt / roundtrips
+
+        best_v2 = best_v1 = float("inf")
+        for rep in range(max(1, reps)):
+            # interleave the dialects so drift hits both sides equally
+            best_v2 = min(best_v2, timed(WIRE_V2))
+            best_v1 = min(best_v1, timed(WIRE_V1))
+            log(f"wire rep {rep}: v2 {best_v2 * 1e3:.2f}ms, "
+                f"legacy {best_v1 * 1e3:.2f}ms per round-trip "
+                f"({payload_mb:.1f}MB each way)")
+    finally:
+        server.stop()
+
+    overhead = best_v2 / best_v1 - 1.0
+    log(f"wire bench: checksummed framing overhead "
+        f"{overhead * 100:+.2f}% on a {payload_mb:.1f}MB push/pull "
+        f"round-trip (acceptance <=5%)")
+    return {
+        "metric": "wire_v2_framing_overhead_fraction",
+        "value": round(overhead, 4),
+        "unit": "fraction",
+        "roundtrip_v2_ms": round(best_v2 * 1e3, 3),
+        "roundtrip_legacy_ms": round(best_v1 * 1e3, 3),
+        "payload_mb_each_way": round(payload_mb, 2),
+        "roundtrips": roundtrips,
+        "config": f"{payload_mb:.0f}MB-rt{roundtrips}",
+    }
+
+
 def make_model(input_dim, nb_classes):
     import keras
 
@@ -1807,6 +1890,16 @@ def main():
         elasticity = None
     if elasticity is not None:
         result["elasticity"] = elasticity
+        print(json.dumps(result), flush=True)
+
+    # -- wire phase: checksummed v2 framing tax on push/pull --------------
+    try:
+        wire = bench_wire(reps)
+    except Exception as e:
+        log(f"wire bench failed: {type(e).__name__}: {e}")
+        wire = None
+    if wire is not None:
+        result["wire"] = wire
         print(json.dumps(result), flush=True)
 
     # -- LM phase: FLOPs-accounted tokens/sec + MFU on the same chip ------

@@ -4,8 +4,10 @@ A ground-up JAX/XLA rebuild of the capabilities of b13n3rd/elephas
 ("Distributed Deep Learning with Keras & Spark"): Keras-3 models train
 data-parallel over a ``jax.sharding.Mesh``, with elephas's synchronous
 delta-averaging and asynchronous/hogwild parameter-server modes realized as
-XLA collectives over ICI (fast path) or a wire-compatible host parameter
-server (compatibility path). The Spark-facing surfaces are preserved over a
+XLA collectives over ICI (fast path) or a host parameter server
+(compatibility path) whose checksummed v2 wire framing negotiates down to
+the reference's legacy ASCII framing per connection, so reference-shaped
+peers still interoperate. The Spark-facing surfaces are preserved over a
 local facade: see :mod:`elephas_tpu.data`.
 """
 

@@ -101,6 +101,12 @@ class ElasticConfig:
     bind_host: str = "127.0.0.1"
     coordinator_address: Optional[str] = None   # jax backend only
     quiet_workers: bool = True
+    # Wire hardening for the control-plane connections: declared-length
+    # ceiling (None = sockets.DEFAULT_MAX_FRAME_BYTES) and the optional
+    # mid-frame progress deadline (None = idle reads stay unbounded; a
+    # host mid-frame is then bounded only by lease expiry).
+    max_frame_bytes: Optional[int] = None
+    stall_timeout_s: Optional[float] = None
 
 
 class _RoundState:
@@ -166,11 +172,15 @@ class ElasticHostPool:
         self.stats: Dict[str, int] = {
             "rounds_committed": 0, "reformations": 0, "rejected_stale": 0,
             "discarded_reformation": 0, "kills": 0, "partitions": 0,
+            "wire_errors": 0,
         }
         self.address: Optional[str] = None
         self._lock = threading.Lock()
         self._queue: "queue.Queue" = queue.Queue()
         self._conns: Dict[int, socket.socket] = {}
+        # Per-host wire dialect, learned from each received frame (workers
+        # in this repo speak v2; a legacy worker would be answered in kind).
+        self._wire_versions: Dict[int, int] = {}
         self._devices: Dict[int, int] = {}
         self._pending_hello: Dict[int, Dict[str, Any]] = {}
         self._unadmitted: Set[int] = set()
@@ -211,21 +221,51 @@ class ElasticHostPool:
 
         All policy (liveness, epochs, admission) lives on the main loop, so
         two hosts' messages can race on the wire without ever racing a
-        registry mutation."""
+        registry mutation. A frame that fails to decode (corrupt, oversize,
+        truncated, stalled — ``sockets.FrameError``) quarantines THIS
+        host's connection: counted in ``stats['wire_errors']``, the member
+        expires through the normal eof path, and the round re-forms over
+        the survivors — corruption is membership churn, never bad weights."""
         host = None
         buf = socket_utils.ReusableBuffer()
+        cfg = self.config
+        if self.plan is not None and getattr(self.plan, "has_wire_faults",
+                                             lambda: False)():
+            conn = self.plan.wrap_socket(conn, site="elastic-driver")
+        max_frame = (socket_utils.DEFAULT_MAX_FRAME_BYTES
+                     if cfg.max_frame_bytes is None
+                     else int(cfg.max_frame_bytes))
         try:
-            hello = socket_utils.receive(conn)
+            hello, wire = socket_utils.receive_frame(
+                conn, max_frame_bytes=max_frame,
+                stall_timeout_s=cfg.stall_timeout_s,
+            )
             if not isinstance(hello, dict) or hello.get("op") != "hello":
                 conn.close()
                 return
             host = int(hello["host"])
             with self._lock:
                 self._conns[host] = conn
+                self._wire_versions[host] = wire
             self._queue.put(("hello", host, hello))
             while True:
-                msg = socket_utils.receive(conn, buf)
+                msg, wire = socket_utils.receive_frame(
+                    conn, buf, max_frame_bytes=max_frame,
+                    stall_timeout_s=cfg.stall_timeout_s,
+                )
+                self._wire_versions[host] = wire
                 self._queue.put((msg.get("op"), host, msg))
+        except socket_utils.FrameError as err:
+            self.stats["wire_errors"] += 1
+            if self.plan is not None and hasattr(self.plan,
+                                                 "note_wire_caught"):
+                self.plan.note_wire_caught("elastic-driver", err)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            if host is not None:
+                self._queue.put(("eof", host, None))
         except (ConnectionError, EOFError, OSError):
             if host is not None:
                 self._queue.put(("eof", host, None))
@@ -233,10 +273,11 @@ class ElasticHostPool:
     def _send(self, host_id: int, msg: Dict[str, Any]) -> bool:
         with self._lock:
             conn = self._conns.get(host_id)
+            wire = self._wire_versions.get(host_id, socket_utils.WIRE_V2)
         if conn is None:
             return False
         try:
-            socket_utils.send(conn, msg)
+            socket_utils.send(conn, msg, version=wire)
             return True
         except OSError:
             return False
@@ -275,6 +316,7 @@ class ElasticHostPool:
         elif op == "eof":
             with self._lock:
                 self._conns.pop(host, None)
+                self._wire_versions.pop(host, None)
             if self.registry.is_live(member):
                 self.registry.expire(member)
         elif op == "goodbye":

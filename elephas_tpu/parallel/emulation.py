@@ -9,8 +9,9 @@ exercise the elastic control plane — process boundaries, SIGKILL, reconnects
 - every "host" is a real OS **process** (spawned here, killed with a real
   ``SIGKILL``), so host death is genuine process death, not a mocked flag;
 - hosts talk to the driver over real TCP using the parameter-server framing
-  from :mod:`elephas_tpu.utils.sockets` (fixed-width header + pickle), so
-  connection loss, half-open sockets, and reconnects behave like the wire;
+  from :mod:`elephas_tpu.utils.sockets` (checksummed v2 frames; the driver
+  answers in whatever dialect the worker speaks), so connection loss,
+  half-open sockets, corrupt frames, and reconnects behave like the wire;
 - the cross-host gradient exchange is a **proxy collective**: each host
   sends its round delta to the driver, which reduces over the membership
   epoch's live set and commits through the versioned parameter-server store
@@ -165,11 +166,17 @@ def _resolve_task(spec: Dict[str, Any]):
 # --------------------------------------------------------------------------
 
 def worker_main(driver: str, host_id: int, devices: int = 1,
-                connect_timeout_s: float = 30.0) -> int:
+                connect_timeout_s: float = 30.0,
+                max_frame_bytes: Optional[int] = None) -> int:
     sock = _sockets.connect_with_retry(driver, timeout_s=connect_timeout_s)
     send_lock = threading.Lock()
+    rxbuf = _sockets.ReusableBuffer()
+    max_frame = (_sockets.DEFAULT_MAX_FRAME_BYTES if max_frame_bytes is None
+                 else int(max_frame_bytes))
 
     def send(msg: Dict[str, Any]) -> None:
+        # workers speak checksummed v2 frames (sockets.send default); the
+        # driver's bilingual reader answers in kind
         with send_lock:
             _sockets.send(sock, msg)
 
@@ -188,7 +195,7 @@ def worker_main(driver: str, host_id: int, devices: int = 1,
 
     try:
         while True:
-            msg = _sockets.receive(sock)
+            msg = _sockets.receive(sock, rxbuf, max_frame_bytes=max_frame)
             op = msg.get("op")
             if op == "adopt":
                 task_fn = _resolve_task(msg["task"])

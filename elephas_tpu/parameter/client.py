@@ -26,14 +26,26 @@ class BaseParameterClient:
     @staticmethod
     def get_client(client_mode: str = "http", port: int = 4000,
                    host: Optional[str] = None,
-                   timeout: float = 60.0) -> "BaseParameterClient":
+                   timeout: float = 60.0,
+                   fault_plan=None,
+                   max_frame_bytes: Optional[int] = None,
+                   stall_timeout_s: Optional[float] = None,
+                   wire_version: Optional[int] = None
+                   ) -> "BaseParameterClient":
         """Factory mirroring the reference's client selection
         (``parameter/client.py:~15``). ``timeout`` bounds every wire
-        operation (the reference hard-codes 60s at each call site)."""
+        operation (the reference hard-codes 60s at each call site).
+        The wire knobs (``fault_plan``'s byte-level sites,
+        ``max_frame_bytes``, ``stall_timeout_s``, ``wire_version``) apply
+        to the raw-TCP transport only; HTTP rides urllib's own framing."""
         if client_mode == "http":
             return HttpClient(port=port, host=host, timeout=timeout)
         if client_mode == "socket":
-            return SocketClient(port=port, host=host, timeout=timeout)
+            return SocketClient(port=port, host=host, timeout=timeout,
+                                fault_plan=fault_plan,
+                                max_frame_bytes=max_frame_bytes,
+                                stall_timeout_s=stall_timeout_s,
+                                wire_version=wire_version)
         raise ValueError(f"Unknown parameter server mode: {client_mode}")
 
     #: highest server weight-version this client has observed (piggybacked
@@ -180,19 +192,49 @@ class SocketClient(BaseParameterClient):
     resets (server restart, failover, idle LB reap). Every operation retries
     ONCE on a fresh connection after a ``ConnectionError``/``OSError`` —
     without this, the first op after a reset failed the whole worker task
-    even though the server was back. ``socket.timeout`` is never blindly
-    retried: a timed-out push may have been applied, and re-sending it is
-    exactly the double-apply the attempt machinery exists to prevent (the
-    retry decision belongs to the policy layer, which knows the semantics).
+    even though the server was back. Typed frame errors (corrupt/truncated/
+    oversize/stalled — ``utils.sockets.FrameError``) are connection errors
+    by design and take the same reconnect-and-retry path, counted in
+    ``wire_errors``. ``socket.timeout`` is never blindly retried: a
+    timed-out push may have been applied, and re-sending it is exactly the
+    double-apply the attempt machinery exists to prevent (the retry
+    decision belongs to the policy layer, which knows the semantics).
+
+    Wire negotiation: with ``wire_version=None`` each fresh connection
+    opens with the ``b"W"`` hello; a v2 server acks and the connection
+    speaks checksummed v2 frames both ways, a legacy server closes on the
+    unknown opcode and the client silently redials speaking legacy
+    (``wire_version=1`` skips the probe; ``wire_version=2`` makes a
+    missing ack a hard typed error).
     """
 
     def __init__(self, port: int = 4000, host: Optional[str] = None,
-                 timeout: float = 60.0):
+                 timeout: float = 60.0, *,
+                 fault_plan=None,
+                 max_frame_bytes: Optional[int] = None,
+                 stall_timeout_s: Optional[float] = None,
+                 wire_version: Optional[int] = None):
         if host is None:
             host = determine_master(port).rsplit(":", 1)[0]
         self.host = host
         self.port = int(port)
         self.timeout = float(timeout)
+        self.fault_plan = fault_plan
+        self.max_frame_bytes = (socket_utils.DEFAULT_MAX_FRAME_BYTES
+                                if max_frame_bytes is None
+                                else int(max_frame_bytes))
+        # Mid-frame progress deadline (slow-loris defense): None keeps the
+        # socket's own 60s op timeout as the only bound.
+        self.stall_timeout_s = (None if stall_timeout_s is None
+                                else float(stall_timeout_s))
+        if wire_version not in (None, socket_utils.WIRE_V1,
+                                socket_utils.WIRE_V2):
+            raise ValueError(f"unknown wire_version {wire_version!r}")
+        self._forced_wire = wire_version
+        #: framing of the CURRENT connection (set per connect by the hello)
+        self._conn_wire = socket_utils.WIRE_V1
+        #: typed frame errors observed (corrupt replies, stalls, oversize)
+        self.wire_errors = 0
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
         # per-client receive buffer: weight pulls land in one reused
@@ -207,12 +249,70 @@ class SocketClient(BaseParameterClient):
         # to plain b"g" pulls (version piggyback off, like pre-header HTTP).
         self._versioned_pull = True
 
+    @property
+    def negotiated_wire_version(self) -> int:
+        """Framing of the current (or most recent) connection."""
+        return self._conn_wire
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection(
+            (self.host, self.port), timeout=self.timeout
+        )
+        plan = self.fault_plan
+        if plan is not None and getattr(plan, "has_wire_faults",
+                                        lambda: False)():
+            sock = plan.wrap_socket(sock, site="client")
+        return sock
+
+    @staticmethod
+    def _handshake(sock) -> bool:
+        """Send the v2 hello; True iff the server acks it. A legacy server
+        closes on the unknown opcode (recv returns b"") → False."""
+        try:
+            sock.sendall(socket_utils.NEGOTIATE_REQUEST)
+            ack = b""
+            while len(ack) < len(socket_utils.NEGOTIATE_ACK):
+                chunk = sock.recv(len(socket_utils.NEGOTIATE_ACK) - len(ack))
+                if not chunk:
+                    return False
+                ack += chunk
+            return ack == socket_utils.NEGOTIATE_ACK
+        except (ConnectionError, OSError):
+            return False
+
     def _ensure(self) -> socket.socket:
         if self._sock is None:
-            self._sock = socket.create_connection(
-                (self.host, self.port), timeout=self.timeout
-            )
+            sock = self._connect()
+            if self._forced_wire == socket_utils.WIRE_V1:
+                self._conn_wire = socket_utils.WIRE_V1
+            elif self._handshake(sock):
+                self._conn_wire = socket_utils.WIRE_V2
+            else:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                if self._forced_wire == socket_utils.WIRE_V2:
+                    raise socket_utils.CorruptFrameError(
+                        f"server {self.host}:{self.port} did not acknowledge "
+                        "v2 framing (wire_version=2 was forced)"
+                    )
+                # Legacy peer: it closed our probe connection — redial and
+                # speak the reference framing. Re-probed on every fresh
+                # connection, so a later server upgrade is picked up.
+                sock = self._connect()
+                self._conn_wire = socket_utils.WIRE_V1
+            self._sock = sock
         return self._sock
+
+    def _send_frame(self, sock, obj) -> None:
+        socket_utils.send(sock, obj, version=self._conn_wire)
+
+    def _receive(self, sock):
+        return socket_utils.receive(
+            sock, buf=self._rxbuf, max_frame_bytes=self.max_frame_bytes,
+            stall_timeout_s=self.stall_timeout_s, mid_message=True,
+        )
 
     def _reset(self) -> None:
         # caller holds the lock
@@ -223,6 +323,13 @@ class SocketClient(BaseParameterClient):
                 pass
             self._sock = None
 
+    def _note_wire_error(self, err: BaseException) -> None:
+        if isinstance(err, socket_utils.FrameError):
+            self.wire_errors += 1
+            plan = self.fault_plan
+            if plan is not None and hasattr(plan, "note_wire_caught"):
+                plan.note_wire_caught("client", err)
+
     def _roundtrip(self, op):
         """Run ``op(sock)`` with one reconnect on a stale connection.
         Caller holds the lock."""
@@ -230,32 +337,60 @@ class SocketClient(BaseParameterClient):
             return op(self._ensure())
         except socket.timeout:
             raise
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError) as err:
+            self._note_wire_error(err)
             self._reset()
             try:
                 return op(self._ensure())
             except socket.timeout:
                 raise
-            except (ConnectionError, OSError):
+            except (ConnectionError, OSError) as err2:
                 # the fresh connection failed too: the server is genuinely
                 # gone — drop the socket so a future call reconnects cleanly
+                self._note_wire_error(err2)
                 self._reset()
                 raise
+
+    @staticmethod
+    def _expect_shape(reply, check: bool, what: str):
+        """Reply-shape validation: a frame that decodes but has the wrong
+        structure for the request (a duplicated/replayed reply desyncing
+        the stream) is wire damage, typed so the reconnect path resyncs —
+        not a bare TypeError deep in the caller."""
+        if not check:
+            raise socket_utils.CorruptFrameError(
+                f"expected {what} reply, got {type(reply).__name__} "
+                "(reply stream desynchronized?)"
+            )
+        return reply
 
     def get_parameters(self) -> List[np.ndarray]:
         def op_versioned(sock):
             sock.sendall(b"G")
-            return socket_utils.receive(sock, buf=self._rxbuf)
+            reply = self._receive(sock)
+            return self._expect_shape(
+                reply,
+                isinstance(reply, tuple) and len(reply) == 2
+                and isinstance(reply[0], (int, np.integer)),
+                "(version, weights)",
+            )
 
         def op_legacy(sock):
             sock.sendall(b"g")
-            return socket_utils.receive(sock, buf=self._rxbuf)
+            reply = self._receive(sock)
+            return self._expect_shape(reply, isinstance(reply, list),
+                                      "weight-list")
 
         with self._lock:
             if self._versioned_pull:
                 try:
                     version, weights = self._roundtrip(op_versioned)
                 except socket.timeout:
+                    raise
+                except socket_utils.FrameError:
+                    # The server SPOKE (a frame arrived, just broken): this
+                    # is wire damage, not a missing versioned-pull API —
+                    # keep the capability and let the policy layer retry.
                     raise
                 except (ConnectionError, OSError):
                     # Either a legacy server closed on the unknown opcode
@@ -279,7 +414,9 @@ class SocketClient(BaseParameterClient):
     def get_version(self) -> int:
         def op(sock):
             sock.sendall(b"v")
-            return int(socket_utils.receive(sock, buf=self._rxbuf))
+            reply = self._receive(sock)
+            return int(self._expect_shape(
+                reply, isinstance(reply, (int, np.integer)), "version-int"))
 
         with self._lock:
             version = self._roundtrip(op)
@@ -289,7 +426,7 @@ class SocketClient(BaseParameterClient):
     def update_parameters(self, delta: List[np.ndarray]) -> None:
         def op(sock):
             sock.sendall(b"u")
-            socket_utils.send(sock, delta)
+            self._send_frame(sock, delta)
 
         with self._lock:
             self._roundtrip(op)
@@ -301,7 +438,7 @@ class SocketClient(BaseParameterClient):
                 sock = self._ensure()
                 try:
                     sock.sendall(b"r")
-                    socket_utils.send(sock, (task_id, int(attempt)))
+                    self._send_frame(sock, (task_id, int(attempt)))
                     ack = sock.recv(1)
                 except socket.timeout:
                     # Slow server ≠ missing attempt API: it may have
@@ -340,10 +477,10 @@ class SocketClient(BaseParameterClient):
         def op(sock):
             if attempt is None:
                 sock.sendall(b"t")
-                socket_utils.send(sock, (task_id, delta))
+                self._send_frame(sock, (task_id, delta))
             else:
                 sock.sendall(b"a")
-                socket_utils.send(sock, (task_id, int(attempt), delta))
+                self._send_frame(sock, (task_id, int(attempt), delta))
 
         with self._lock:
             self._roundtrip(op)
@@ -351,7 +488,7 @@ class SocketClient(BaseParameterClient):
     def commit_attempt(self, task_id: str) -> None:
         def op(sock):
             sock.sendall(b"c")
-            socket_utils.send(sock, task_id)
+            self._send_frame(sock, task_id)
 
         with self._lock:
             self._roundtrip(op)

@@ -1,4 +1,5 @@
-"""Parameter servers: HTTP and raw-TCP, wire-compatible with the reference.
+"""Parameter servers: HTTP and raw-TCP, interoperable with the reference
+via per-connection wire negotiation.
 
 Rebuild of reference ``elephas/parameter/server.py:~1`` (``BaseParameterServer``,
 ``HttpServer`` — Flask ``GET /parameters`` / ``POST /update`` under a
@@ -18,8 +19,20 @@ Differences from the reference, deliberate:
   process — workers here are threads in the same process (local mesh), so a
   fork would only add IPC latency. The lock/hogwild distinction is unchanged.
 
+Wire formats (SocketServer): connections open speaking the reference's
+ASCII-header framing; a client that sends the ``b"W"`` hello
+(``utils.sockets.NEGOTIATE_REQUEST``) is acked and the connection switches
+to checksummed v2 frames both ways — so reference-shaped clients and v2
+clients share one port. Frame decode failures (corrupt/garbage/truncated/
+oversize — ``utils.sockets.FrameError``) quarantine THAT connection only
+(closed, counted in ``wire_errors``); other connections are untouched and
+the rejected payload is never applied.
+
 Security note: payloads are pickled Python objects, exactly like the
 reference — only ever bind these servers on trusted networks.
+``max_frame_bytes`` bounds what a hostile peer can make the server
+allocate, but unpickling itself is code execution: the frame layer is a
+corruption detector, not an authentication boundary.
 """
 
 from __future__ import annotations
@@ -87,6 +100,10 @@ class BaseParameterServer:
         self._repl_queue: Any = None
         self._repl_thread: Any = None
         self.replication_errors = 0
+        # Typed frame errors caught on this server's connections (corrupt /
+        # truncated / oversize / stalled frames, unknown opcodes). Each one
+        # quarantined its connection; none of them touched the weights.
+        self.wire_errors = 0
 
     # -- liveness (injected kill) ----------------------------------------
     def _check_alive(self) -> None:
@@ -432,17 +449,33 @@ class HttpServer(BaseParameterServer):
 
 class SocketServer(BaseParameterServer):
     """Raw-TCP server: 1-byte opcodes ``b'g'`` (get) / ``b'u'`` (update),
-    fixed-width-header pickle framing from ``elephas_tpu.utils.sockets``.
+    pickle framing from ``elephas_tpu.utils.sockets`` (legacy ASCII header
+    or checksummed v2, negotiated per connection via the ``b'W'`` hello).
 
     Reference: ``parameter/server.py:~100`` (``action_listener`` thread per
     accepted connection). Extension opcodes beyond the reference protocol:
     ``b't'`` (task-tagged update) and ``b'r'`` (register task attempt) for
-    exactly-once retry semantics — see ``register_attempt``.
+    exactly-once retry semantics — see ``register_attempt`` — and ``b'W'``
+    (wire negotiation). Receives are bilingual regardless of negotiation;
+    REPLIES use the dialect the connection negotiated (legacy until a
+    ``b'W'`` hello lands), so a reference client never sees a v2 frame.
+
+    ``max_frame_bytes`` bounds any declared frame length before allocation
+    (hostile-header defense); ``stall_timeout_s`` (optional) is the
+    mid-frame progress deadline that disconnects a slow-loris peer without
+    touching idle-between-requests connections.
     """
 
     def __init__(self, weights: List[np.ndarray], mode: str = "asynchronous",
-                 port: int = 4000, **kwargs):
+                 port: int = 4000, *,
+                 max_frame_bytes: Optional[int] = None,
+                 stall_timeout_s: Optional[float] = None, **kwargs):
         super().__init__(weights, mode=mode, port=port, **kwargs)
+        self.max_frame_bytes = (socket_utils.DEFAULT_MAX_FRAME_BYTES
+                                if max_frame_bytes is None
+                                else int(max_frame_bytes))
+        self.stall_timeout_s = (None if stall_timeout_s is None
+                                else float(stall_timeout_s))
         self._sock: Optional[socket.socket] = None
         self._thread: Optional[threading.Thread] = None
         self._stop_event = threading.Event()
@@ -468,6 +501,12 @@ class SocketServer(BaseParameterServer):
                 continue
             except OSError:
                 break
+            plan = self.fault_plan
+            if plan is not None and getattr(plan, "has_wire_faults",
+                                            lambda: False)():
+                # wire-level chaos: the server's REPLIES pass through the
+                # shim (the client's own shim covers the other direction)
+                conn = plan.wrap_socket(conn, site="server")
             t = threading.Thread(target=self._action_listener, args=(conn,), daemon=True)
             t.start()
             self._conn_threads.append(t)
@@ -477,39 +516,64 @@ class SocketServer(BaseParameterServer):
         # exactly this thread): every push's multi-MB delta lands in the
         # same reused allocation instead of a fresh one per round
         rxbuf = socket_utils.ReusableBuffer()
+        # replies speak legacy until the connection negotiates v2 (b"W")
+        wire_version = socket_utils.WIRE_V1
+
+        def recv_frame(buf=None):
+            # the opcode already arrived, so this read is mid-message: the
+            # stall deadline (if configured) applies from the first byte
+            return socket_utils.receive(
+                conn, buf=buf, max_frame_bytes=self.max_frame_bytes,
+                stall_timeout_s=self.stall_timeout_s, mid_message=True,
+            )
+
+        def reply(obj):
+            socket_utils.send(conn, obj, version=wire_version)
+
         try:
             while not self._stop_event.is_set():
                 op = conn.recv(1)
                 if not op:
                     break
-                if op == b"g":
-                    socket_utils.send(conn, self.get_weights())
+                if op == socket_utils.NEGOTIATE_OP:
+                    hello = socket_utils.receive_all(
+                        conn, len(socket_utils.NEGOTIATE_REQUEST) - 1,
+                        stall_timeout_s=self.stall_timeout_s,
+                    )
+                    if bytes(hello) != socket_utils.MAGIC:
+                        raise socket_utils.CorruptFrameError(
+                            f"bad negotiation hello {bytes(hello)!r} from "
+                            "peer"
+                        )
+                    conn.sendall(socket_utils.NEGOTIATE_ACK)
+                    wire_version = socket_utils.WIRE_V2
+                elif op == b"g":
+                    reply(self.get_weights())
                 elif op == b"G":
                     # versioned pull: one atomic (version, weights) pair —
                     # the socket transport's answer to HTTP's
                     # X-Elephas-Version header (a legacy server hits the
-                    # `else: break` below and closes, which the client
+                    # unknown-opcode close below, which the client
                     # reads as "no versioned-pull API" and degrades)
-                    socket_utils.send(conn, self.get_versioned_weights())
+                    reply(self.get_versioned_weights())
                 elif op == b"u":
-                    delta = socket_utils.receive(conn, buf=rxbuf)
+                    delta = recv_frame(buf=rxbuf)
                     self.apply_delta(delta)
                 elif op == b"t":
                     # tagged update: (task_id, delta) — exactly-once retries
-                    task_id, delta = socket_utils.receive(conn, buf=rxbuf)
+                    task_id, delta = recv_frame(buf=rxbuf)
                     self.apply_delta(delta, task_id=task_id)
                 elif op == b"a":
                     # attempt-tagged update: (task_id, attempt, delta) —
                     # lets the server fence zombie attempts' pushes
-                    task_id, attempt, delta = socket_utils.receive(
-                        conn, buf=rxbuf)
+                    task_id, attempt, delta = recv_frame(buf=rxbuf)
                     self.apply_delta(delta, task_id=task_id, attempt=attempt)
                 elif op == b"r":
                     # register (task_id, attempt); ack so the client can
                     # order its first pull after the rollback. A dead server
                     # acks b'x' (distinguishable from a legacy server's
                     # silent close, which means "no attempt API").
-                    task_id, attempt = socket_utils.receive(conn)
+                    task_id, attempt = recv_frame()
                     try:
                         self.register_attempt(task_id, attempt)
                     except ConnectionError:
@@ -518,14 +582,28 @@ class SocketServer(BaseParameterServer):
                     conn.sendall(b"k")
                 elif op == b"c":
                     # commit: task finished cleanly, drop its accumulator
-                    task_id = socket_utils.receive(conn)
+                    task_id = recv_frame()
                     self.commit_attempt(task_id)
                 elif op == b"v":
                     # monotonic weight version (staleness bound on failover)
                     self._check_alive()
-                    socket_utils.send(conn, self.version)
+                    reply(self.version)
                 else:
-                    break
+                    # Unknown opcode: either a legacy-probe close (the
+                    # client reads the close as "API absent") or stream
+                    # garbage — either way, quarantine this connection.
+                    raise socket_utils.CorruptFrameError(
+                        f"unknown opcode {op!r} on parameter-server "
+                        "connection"
+                    )
+        except socket_utils.FrameError as err:
+            # Corrupt / truncated / oversize / stalled frame: the payload
+            # was rejected BEFORE any apply. Quarantine = close just this
+            # connection; every other client keeps its own untouched.
+            self.wire_errors += 1
+            plan = self.fault_plan
+            if plan is not None and hasattr(plan, "note_wire_caught"):
+                plan.note_wire_caught("server", err)
         except (ConnectionError, OSError):
             pass
         finally:

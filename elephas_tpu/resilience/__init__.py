@@ -21,6 +21,12 @@ condition injectable, policed, and recoverable across BOTH pipelines:
   ``ResilientClient``, which routes any
   :class:`~elephas_tpu.parameter.client.BaseParameterClient`'s pulls and
   pushes through both.
+- :mod:`~elephas_tpu.resilience.soak` — the randomized cross-stack chaos
+  soak: each seeded schedule draws a random COMBINATION of fault rates
+  (logical + wire-level under the checksummed socket framing) and applies
+  it to a composed stack — sync/async/hogwild fit, streaming
+  train-to-serve, the trace-driven fleet — with a global invariant check
+  per run (``run_soak``; pinned in ``tests/resilience/test_soak.py``).
 - :mod:`~elephas_tpu.resilience.supervisor` — ``TrainingSupervisor``:
   wraps ``SparkModel.fit`` with periodic checkpointing
   (:mod:`elephas_tpu.utils.checkpoint`) and auto-resume from the latest
@@ -58,9 +64,21 @@ from .policy import (
     RetryPolicy,
     default_is_transient,
 )
+from .soak import (
+    SCENARIOS,
+    SoakInvariantViolation,
+    draw_fault_kwargs,
+    run_schedule,
+    run_soak,
+)
 from .supervisor import SupervisorAborted, SupervisorEvent, TrainingSupervisor
 
 __all__ = [
+    "SCENARIOS",
+    "SoakInvariantViolation",
+    "draw_fault_kwargs",
+    "run_schedule",
+    "run_soak",
     "CircuitBreaker",
     "CircuitOpenError",
     "FailoverClient",

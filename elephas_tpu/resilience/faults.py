@@ -38,6 +38,17 @@ Injection points, one per layer the tentpole names:
   zombie keeps computing; its delta must be fenced), and a deferred
   admission of a freshly spawned host (late join). All exact round→host
   maps, so membership-event traces pin at fixed seed.
+- **the wire itself** — :meth:`FaultPlan.wrap_socket` returns a
+  :class:`FaultySocket` shim that mutates outbound FRAMES (never 1-byte
+  opcodes/acks or the 5-byte negotiation hello, so every injected
+  corruption lands in checksummed frame bytes): ``wire_flip_bits`` XORs
+  one deterministic bit, ``wire_garbage`` overwrites the frame head with
+  junk, ``wire_truncate`` sends a prefix then closes, ``wire_duplicate``
+  sends the frame twice, and ``wire_stall_s``/``wire_stall_prob`` sleep
+  mid-frame (the slow-loris). Per-frame verdicts are seeded and
+  per-opportunity like every other site; fires are counted in ``fired``
+  and every typed catch the stack reports lands in ``wire_caught`` — the
+  soak's "corruption is caught, never applied" ledger.
 
 Faults fire AT MOST ONCE per crash site (``fired``/``crash_fired``
 bookkeeping), so retries and supervisor restarts proceed — the injected
@@ -47,6 +58,7 @@ failure is a crash, not a curse.
 from __future__ import annotations
 
 import hashlib
+import socket as socket_mod
 import threading
 import time
 from typing import Callable, Dict, Iterable, Optional, Tuple
@@ -103,6 +115,12 @@ class FaultPlan:
                  kill_hosts: Optional[Dict[int, int]] = None,
                  partition_hosts: Optional[Dict[int, int]] = None,
                  join_delay_rounds: Optional[Dict[int, int]] = None,
+                 wire_flip_bits: float = 0.0,
+                 wire_truncate: float = 0.0,
+                 wire_garbage: float = 0.0,
+                 wire_duplicate: float = 0.0,
+                 wire_stall_s: float = 0.0,
+                 wire_stall_prob: float = 0.0,
                  sleep: Callable[[float], None] = time.sleep):
         self.seed = int(seed)
         self.drop_push = float(drop_push)
@@ -144,11 +162,27 @@ class FaultPlan:
         self.join_delay_rounds = {
             int(h): int(d) for h, d in (join_delay_rounds or {}).items()
         }
+        # Wire-level sites (per outbound frame, through wrap_socket's shim).
+        # Rates are per-frame probabilities; wire_stall_s is the injected
+        # mid-frame sleep, gated by wire_stall_prob.
+        self.wire_flip_bits = float(wire_flip_bits)
+        self.wire_truncate = float(wire_truncate)
+        self.wire_garbage = float(wire_garbage)
+        self.wire_duplicate = float(wire_duplicate)
+        self.wire_stall_s = float(wire_stall_s)
+        self.wire_stall_prob = float(wire_stall_prob)
         self.sleep = sleep
         self._lock = threading.Lock()
         self._counters: Dict[str, int] = {}
         self._push_counts: Dict[Tuple[int, int], int] = {}
         self.fired: Dict[str, int] = {}      # site -> call index it fired at
+        # (wire sites record a FIRE COUNT per "<kind>:<site>" key instead of
+        # a call index — a rate site can fire many times)
+        # Catches reported back by the stack (client/server/elastic pool):
+        # exception type name -> count. Together with `fired` this is the
+        # soak's corruption ledger: injected corruption must show up HERE,
+        # never in the applied weights.
+        self.wire_caught: Dict[str, int] = {}
 
     # -- the decision primitive ------------------------------------------
     def decide(self, site: str, rate: float) -> bool:
@@ -326,6 +360,153 @@ class FaultPlan:
         """Seconds of injected wall-clock stall at engine step
         ``step_index`` (deterministic: an explicit step → seconds map)."""
         return float(self.serving_stalls.get(int(step_index), 0.0))
+
+    # -- wire-level faults (byte-level, through wrap_socket) ---------------
+    #: sendall payloads at or below this many bytes are control traffic
+    #: (1-byte opcodes, the 5-byte negotiation hello, 1/4-byte acks) and
+    #: pass through untouched — every injected corruption therefore lands
+    #: inside a FRAME (v2 header 18B + payload, legacy header 20B), which
+    #: is what makes "every flip is caught by the framing layer" provable.
+    _WIRE_CONTROL_MAX = 16
+
+    def has_wire_faults(self) -> bool:
+        """True when any wire-level site could fire (wrap_socket is then
+        worth the shim; otherwise it returns the socket unwrapped)."""
+        return (self.wire_flip_bits > 0.0 or self.wire_truncate > 0.0
+                or self.wire_garbage > 0.0 or self.wire_duplicate > 0.0
+                or (self.wire_stall_s > 0.0 and self.wire_stall_prob > 0.0))
+
+    def wrap_socket(self, sock, site: str):
+        """Wrap ``sock`` so outbound frames pass through this plan's wire
+        sites. Returns ``sock`` unchanged when no wire site is active."""
+        if not self.has_wire_faults():
+            return sock
+        return FaultySocket(sock, self, str(site))
+
+    def note_wire_caught(self, where: str, err: BaseException) -> None:
+        """The stack caught a typed frame error: record it in the ledger
+        (keyed ``where:ExceptionType``). Called by ``SocketClient``,
+        ``SocketServer``, and the elastic pool's readers."""
+        key = f"{where}:{type(err).__name__}"
+        with self._lock:
+            self.wire_caught[key] = self.wire_caught.get(key, 0) + 1
+
+    def wire_caught_total(self) -> int:
+        return sum(self.wire_caught.values())
+
+    def wire_fired_total(self, kinds: Tuple[str, ...] = (
+            "wire_flip_bits", "wire_garbage", "wire_truncate")) -> int:
+        """Total fires across sites for the given wire kinds (default: the
+        CORRUPTING kinds — duplicates and stalls don't damage a frame)."""
+        with self._lock:
+            return sum(count for site, count in self.fired.items()
+                       if site.split(":", 1)[0] in kinds)
+
+    def _record_wire_fire(self, kind: str, site: str) -> int:
+        """Count one fire of ``kind`` at ``site``; returns the 0-based fire
+        index (seeds the deterministic mutation position draws)."""
+        key = f"{kind}:{site}"
+        with self._lock:
+            n = self.fired.get(key, 0)
+            self.fired[key] = n + 1
+        return n
+
+    def wire_send(self, sock, data: bytes, site: str) -> None:
+        """Send ``data`` through the wire-fault sites (the FaultySocket
+        sendall path). Control-sized payloads pass through untouched; for
+        frames, every active kind draws one seeded per-opportunity verdict
+        (all streams advance every frame, so enabling one kind never
+        re-orders another's), and the first destructive verdict wins."""
+        if len(data) <= self._WIRE_CONTROL_MAX:
+            sock.sendall(data)
+            return
+        stall = (self.wire_stall_s > 0.0
+                 and self.decide(f"wire_stall:{site}", self.wire_stall_prob))
+        verdict = None
+        for kind, rate in (("wire_truncate", self.wire_truncate),
+                           ("wire_garbage", self.wire_garbage),
+                           ("wire_flip_bits", self.wire_flip_bits),
+                           ("wire_duplicate", self.wire_duplicate)):
+            if self.decide(f"{kind}:{site}", rate) and verdict is None:
+                verdict = kind
+        if stall:
+            self._record_wire_fire("wire_stall", site)
+
+        def emit(payload: bytes) -> None:
+            if stall:
+                cut = max(1, len(payload) // 2)
+                sock.sendall(payload[:cut])
+                self.sleep(self.wire_stall_s)
+                sock.sendall(payload[cut:])
+            else:
+                sock.sendall(payload)
+
+        if verdict is None:
+            emit(data)
+            return
+        n = self._record_wire_fire(verdict, site)
+        if verdict == "wire_truncate":
+            # Prefix then hard close: the peer sees EOF mid-frame. The
+            # caller believes the send succeeded (like a real network cut —
+            # the sender learns on its NEXT operation) and reconnects then.
+            cut = 1 + int(_unit(self.seed, f"wire_truncate_cut:{site}", n)
+                          * (len(data) - 1))
+            try:
+                sock.sendall(data[:cut])
+                sock.shutdown(socket_mod.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        mutated = bytearray(data)
+        if verdict == "wire_garbage":
+            # Overwrite the frame head with deterministic junk. Byte 0 is
+            # forced to 0xFF — neither v2 magic nor an ASCII digit — so the
+            # receiver types it immediately and quarantines the connection
+            # (the rest of the mutated stream is never parsed).
+            junk = hashlib.blake2b(
+                f"{self.seed}:wire_garbage_bytes:{site}:{n}".encode(),
+                digest_size=32,
+            ).digest()
+            span = min(len(junk), len(mutated))
+            mutated[:span] = junk[:span]
+            mutated[0] = 0xFF
+        elif verdict == "wire_flip_bits":
+            pos = int(_unit(self.seed, f"wire_flip_pos:{site}", n)
+                      * len(mutated))
+            bit = int(_unit(self.seed, f"wire_flip_bit:{site}", n) * 8)
+            mutated[pos] ^= 1 << bit
+        elif verdict == "wire_duplicate":
+            emit(bytes(mutated))  # the original ...
+        emit(bytes(mutated))      # ... and the (possibly mutated) frame
+
+
+class FaultySocket:
+    """A socket shim that routes outbound bytes through a
+    :class:`FaultPlan`'s wire sites (:meth:`FaultPlan.wire_send`).
+
+    Sits UNDER the framing layer: ``sendall`` is intercepted, everything
+    else (``recv``, ``recv_into``, ``settimeout``, ``close``, …) delegates
+    to the wrapped socket, so ``utils.sockets``' send/receive and the
+    stall-deadline save/restore work unchanged. Wrapping only the sender
+    side of each direction covers the whole wire: the client's shim
+    corrupts client→server frames (caught by the server), the server's
+    shim corrupts replies (caught by the client).
+    """
+
+    def __init__(self, sock, plan: FaultPlan, site: str):
+        self._sock = sock
+        self._plan = plan
+        self._site = site
+
+    def sendall(self, data) -> None:
+        self._plan.wire_send(self._sock, bytes(data), self._site)
+
+    def __getattr__(self, name):
+        return getattr(self._sock, name)
 
 
 class FaultyClient(BaseParameterClient):

@@ -80,6 +80,7 @@ class SparkModel:
                  backup_stragglers: bool = True,
                  hot_standby: bool = False,
                  elastic=None,
+                 wire_stall_timeout_s: Optional[float] = None,
                  *args, **kwargs):
         if mode not in ("synchronous", "asynchronous", "hogwild"):
             raise ValueError(f"Unknown mode: {mode}")
@@ -138,6 +139,14 @@ class SparkModel:
         self.fault_plan = fault_plan
         self.retry_policy = retry_policy
         self.ps_timeout = float(ps_timeout)
+        # Per-recv progress deadline for the socket wire (slow-loris guard):
+        # a connection idle BETWEEN frames is fine; one stalled INSIDE a
+        # frame past this deadline raises FrameStalledError and reconnects.
+        # Required when the fault plan has wire_stall/wire_flip sites (a
+        # flipped length field can otherwise hang a receive forever).
+        self.wire_stall_timeout_s = (
+            None if wire_stall_timeout_s is None else float(wire_stall_timeout_s)
+        )
         # Elastic-membership extensions (elephas_tpu.resilience.membership):
         # a HeartbeatRegistry drives K-of-N quorum rounds with straggler
         # backups on the host paths and masks expired workers out of the
@@ -561,9 +570,12 @@ class SparkModel:
             cls = HttpServer
         else:
             cls = SocketServer
+        server_kwargs = {}
+        if cls is SocketServer and self.wire_stall_timeout_s is not None:
+            server_kwargs["stall_timeout_s"] = self.wire_stall_timeout_s
         self._server = cls(
             weights, mode=self.mode, port=self.port,
-            fault_plan=self.fault_plan, name="primary",
+            fault_plan=self.fault_plan, name="primary", **server_kwargs,
         )
         self._server.start()
         self.port = self._server.port  # native server may bind an OS port
@@ -574,6 +586,7 @@ class SparkModel:
             # is exactly what the standby exists to prevent).
             self._standby_server = cls(
                 weights, mode=self.mode, port=0, name="standby",
+                **server_kwargs,
             )
             self._standby_server.start()
             self._server.attach_standby(self._standby_server)
@@ -592,9 +605,16 @@ class SparkModel:
                 codec=make_codec(self.compression),
             )
         else:
+            # Wire knobs reach the socket transport only; get_client ignores
+            # them for http. The fault plan goes in twice on purpose: here it
+            # corrupts the actual bytes on the wire (FaultySocket under the
+            # checksummed framing), while FaultyClient below injects at the
+            # logical request level — the soak composes both.
             client = BaseParameterClient.get_client(
                 self.parameter_server_mode, self.port, host="127.0.0.1",
                 timeout=self.ps_timeout,
+                fault_plan=self.fault_plan,
+                stall_timeout_s=self.wire_stall_timeout_s,
             )
             if self._standby_server is not None:
                 from .resilience.policy import FailoverClient
@@ -605,6 +625,8 @@ class SparkModel:
                 standby = BaseParameterClient.get_client(
                     self.parameter_server_mode, self._standby_server.port,
                     host="127.0.0.1", timeout=self.ps_timeout,
+                    fault_plan=self.fault_plan,
+                    stall_timeout_s=self.wire_stall_timeout_s,
                 )
                 client = FailoverClient(
                     [client, standby], registry=self.membership,

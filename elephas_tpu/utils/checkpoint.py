@@ -9,10 +9,21 @@ optimizer momentum intact.
 Format: a directory with ``weights.npz`` (ordered weight list),
 ``opt_state.npz`` + pickled treedef (the optimizer pytree is flattened to
 leaves; structure travels separately), and ``meta.json``.
+
+Durability: every file is written ATOMICALLY — to a temp sibling, flushed,
+fsynced, then ``os.replace``d into place (:func:`atomic_write`) — and
+``meta.json`` is renamed last (the commit point). A crash at ANY instant
+therefore leaves each file either absent, the previous complete version, or
+the new complete version — never torn — so :func:`has_checkpoint` and
+:func:`load_checkpoint` always see a readable directory. The one remaining
+skew (a crash between the weights rename and the meta rename leaves new
+weights under the previous save's meta) only makes a resume replay work it
+already did; it can never make the checkpoint unreadable.
 """
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import pickle
@@ -20,7 +31,41 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .serialization import load_weights_npz, save_weights_npz
+from .serialization import load_weights_npz
+
+
+@contextlib.contextmanager
+def atomic_write(path: str):
+    """Write ``path`` via temp sibling + flush + fsync + ``os.replace``.
+
+    Yields the (binary) file object for the temp sibling. On success the
+    sibling atomically replaces ``path``; on error it is removed and
+    ``path`` is untouched — a crash mid-write can never leave a torn file
+    where a reader expects a complete one. Same-directory sibling, so the
+    replace never crosses filesystems.
+    """
+    tmp = f"{path}.tmp.{os.getpid()}"
+    f = open(tmp, "wb")
+    try:
+        yield f
+        f.flush()
+        os.fsync(f.fileno())
+        f.close()
+        os.replace(tmp, path)
+    except BaseException:
+        f.close()
+        with contextlib.suppress(OSError):
+            os.unlink(tmp)
+        raise
+    # Best-effort directory fsync: makes the rename itself durable against
+    # power loss, not just process death. Not all filesystems allow it.
+    with contextlib.suppress(OSError):
+        dir_fd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                         os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
 
 
 def _leaf_to_host(leaf) -> np.ndarray:
@@ -56,8 +101,9 @@ def _save_tree(directory: str, tree: Any, leaves_name: str,
     host = {f"l{i}": _leaf_to_host(leaf) for i, leaf in enumerate(leaves)}
     if jax.process_index() != 0:
         return
-    np.savez(os.path.join(directory, leaves_name), **host)
-    with open(os.path.join(directory, treedef_name), "wb") as f:
+    with atomic_write(os.path.join(directory, leaves_name)) as f:
+        np.savez(f, **host)
+    with atomic_write(os.path.join(directory, treedef_name)) as f:
         pickle.dump(treedef, f)
 
 
@@ -81,9 +127,11 @@ def save_checkpoint(directory: str, weights: List[np.ndarray],
         _save_tree(directory, opt_state, "opt_state.npz", "opt_treedef.pkl")
     if jax.process_index() != 0:
         return  # … then only process 0 writes files
-    save_weights_npz(os.path.join(directory, "weights.npz"), weights)
-    with open(os.path.join(directory, "meta.json"), "w") as f:
-        json.dump(meta, f)
+    with atomic_write(os.path.join(directory, "weights.npz")) as f:
+        np.savez(f, **{f"w{i}": np.asarray(w) for i, w in enumerate(weights)})
+    # meta.json renames last: its appearance is the checkpoint's commit point
+    with atomic_write(os.path.join(directory, "meta.json")) as f:
+        f.write(json.dumps(meta).encode("utf-8"))
 
 
 def save_pytree(path: str, tree: Any) -> None:

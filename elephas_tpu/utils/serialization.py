@@ -58,8 +58,26 @@ def dict_to_model(d: Dict[str, Any], custom_objects: Optional[dict] = None):
 
 
 def save_weights_npz(path: str, weights: List[np.ndarray]) -> None:
-    """Persist a weight list as an ordered npz archive (TPU-build extension)."""
-    np.savez(path, **{f"w{i}": np.asarray(w) for i, w in enumerate(weights)})
+    """Persist a weight list as an ordered npz archive (TPU-build extension).
+
+    Written atomically (temp sibling + fsync + rename) so a crash mid-save
+    leaves the previous file intact, never a torn archive."""
+    import os
+
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **{f"w{i}": np.asarray(w)
+                           for i, w in enumerate(weights)})
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
 
 
 def load_weights_npz(path: str) -> List[np.ndarray]:

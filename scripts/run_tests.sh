@@ -25,9 +25,10 @@
 # the slow train-step parity sweeps, `spec`, `streaming` for the
 # train-to-serve rollover pins, `fleet` for the serving-fleet
 # control plane, `elastic` for the elastic multi-host pins with
-# subprocess host emulation, or `paged` for the fused paged-attention
-# kernel oracles + dense-vs-paged identity fuzz + page-pressure chaos
-# — see the matching make targets) restricts
+# subprocess host emulation, `paged` for the fused paged-attention
+# kernel oracles + dense-vs-paged identity fuzz + page-pressure chaos,
+# or `soak` for the randomized cross-stack chaos soak including the slow
+# >=20-schedule acceptance run — see the matching make targets) restricts
 # every shard to that pytest marker. The group's `-m` is appended AFTER the
 # caller's args because pytest honors only the LAST -m — so
 # `ELEPHAS_TEST_GROUP=chaos make test-fast` runs the chaos group even

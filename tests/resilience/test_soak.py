@@ -1,0 +1,99 @@
+"""The randomized cross-stack chaos soak (``elephas_tpu.resilience.soak``).
+
+The smoke test keeps two seeded schedules in tier-1 so the soak harness
+itself can never rot; the full ≥20-schedule acceptance run is marked
+``slow`` and rides the ``soak`` marker group (``make test-soak``).
+"""
+
+import numpy as np
+import pytest
+
+from elephas_tpu.resilience.faults import FaultPlan
+from elephas_tpu.resilience.soak import (
+    SCENARIOS,
+    SoakInvariantViolation,
+    _wire_ledger_check,
+    draw_fault_kwargs,
+    run_schedule,
+    run_soak,
+)
+
+pytestmark = pytest.mark.soak
+
+_WIRE_DESTRUCTIVE = ("wire_flip_bits", "wire_garbage", "wire_truncate")
+
+
+def _fired_destructive(run):
+    return sum(count for site, count in run.get("fired", {}).items()
+               if site.split(":", 1)[0] in _WIRE_DESTRUCTIVE)
+
+
+def test_draw_fault_kwargs_is_pinned_and_bounded():
+    a = draw_fault_kwargs(3, "asynchronous")
+    b = draw_fault_kwargs(3, "asynchronous")
+    assert a == b                       # the schedule itself is seeded
+    for name, value in a.items():
+        if name.startswith(("drop", "dup", "push", "pull", "wire")):
+            assert 0.0 <= float(value) <= 0.2, (name, value)
+    # and it actually varies across seeds (one differing draw suffices)
+    assert any(draw_fault_kwargs(s, "asynchronous") != a for s in range(4, 9))
+
+
+def test_wire_ledger_check_catches_silent_application():
+    """The soak's core claim: destructive wire fires with ZERO typed
+    catches means corruption may have been applied silently — that must
+    be an invariant violation, never a quiet pass."""
+    plan = FaultPlan(seed=0, wire_garbage=0.5)
+    plan.fired["wire_garbage:client"] = 3      # fired ...
+    with pytest.raises(SoakInvariantViolation, match="silently applied"):
+        _wire_ledger_check(plan)               # ... but nothing caught
+    plan.wire_caught["server:CorruptFrameError"] = 1
+    _wire_ledger_check(plan)                   # any typed catch clears it
+
+
+def test_run_schedule_reports_typed_failures_and_raises_the_rest(monkeypatch):
+    def dies_typed(seed):
+        raise ConnectionError("server never came back")
+
+    def dies_untyped(seed):
+        raise ValueError("this is a real bug")
+
+    monkeypatch.setitem(SCENARIOS, "dies-typed", dies_typed)
+    monkeypatch.setitem(SCENARIOS, "dies-untyped", dies_untyped)
+
+    report = run_schedule("dies-typed", 0)
+    assert report["outcome"] == "typed:ConnectionError"
+    assert "never came back" in report["error"]
+
+    with pytest.raises(ValueError, match="real bug"):
+        run_schedule("dies-untyped", 0)
+
+    # run_soak collects instead of dying, so one red seed hides nothing
+    soak = run_soak(n_schedules=2, scenarios=["dies-untyped", "dies-typed"])
+    assert soak["typed_failures"] == 1
+    assert len(soak["failures"]) == 1
+    assert "ValueError" in soak["failures"][0]["error"]
+
+
+@pytest.mark.timeout(300)
+def test_soak_smoke_two_schedules():
+    """Tier-1 canary: two full stream-stack schedules through the real
+    harness (each runs its stack twice for the replay bit-identity
+    check)."""
+    report = run_soak(n_schedules=2, scenarios=["fit-stream"])
+    assert report["failures"] == []
+    assert report["completed"] + report["typed_failures"] == 2
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(1800)
+def test_soak_twenty_five_schedules_across_all_stacks():
+    """The acceptance run: ≥20 seeded schedules round-robined over every
+    stack. Every schedule either completes with invariants green or dies
+    with a named typed error; destructive wire faults must actually have
+    fired somewhere (the storm is real, not a no-op)."""
+    report = run_soak(n_schedules=25, verbose=True)
+    assert report["failures"] == [], report["failures"]
+    assert report["completed"] + report["typed_failures"] == 25
+    assert report["completed"] >= 15     # the rate band keeps most green
+    assert any(_fired_destructive(r) > 0 for r in report["runs"])

@@ -1,0 +1,159 @@
+"""Atomic checkpoint writes: a crash at ANY instant never tears a file.
+
+``atomic_write`` (temp sibling + fsync + ``os.replace``) and the meta-last
+commit ordering in ``save_checkpoint`` promise that a reader always sees
+each file either absent, the previous complete version, or the new complete
+version. These tests crash saves at chosen points (injected exceptions) and
+at arbitrary points (SIGKILL loop) and hold the promise to it.
+
+(The orbax-gated sharded variants live in ``test_checkpoint.py``.)
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from elephas_tpu.utils.checkpoint import (
+    atomic_write,
+    has_checkpoint,
+    load_checkpoint,
+    save_checkpoint,
+)
+from elephas_tpu.utils.serialization import load_weights_npz, save_weights_npz
+
+
+def _tmp_residue(directory):
+    return [n for n in os.listdir(directory) if ".tmp." in n]
+
+
+def _weights(value):
+    return [np.full((3, 2), value, np.float32), np.arange(4, dtype=np.float32)]
+
+
+def test_atomic_write_success_and_no_residue(tmp_path):
+    path = tmp_path / "blob.bin"
+    with atomic_write(str(path)) as f:
+        f.write(b"v1-complete")
+    assert path.read_bytes() == b"v1-complete"
+    assert _tmp_residue(tmp_path) == []
+
+
+def test_atomic_write_crash_keeps_previous_version(tmp_path):
+    path = tmp_path / "blob.bin"
+    path.write_bytes(b"v1-complete")
+    with pytest.raises(RuntimeError, match="crash mid-write"):
+        with atomic_write(str(path)) as f:
+            f.write(b"v2-partia")          # torn write, then the crash
+            raise RuntimeError("crash mid-write")
+    assert path.read_bytes() == b"v1-complete"
+    assert _tmp_residue(tmp_path) == []
+
+
+def test_save_weights_crash_keeps_previous_version(tmp_path, monkeypatch):
+    path = str(tmp_path / "weights.npz")
+    save_weights_npz(path, _weights(1.0))
+
+    real_savez = np.savez
+
+    def torn_savez(f, **arrays):
+        real_savez(f, **arrays)
+        raise OSError("disk gone mid-save")
+
+    monkeypatch.setattr(np, "savez", torn_savez)
+    with pytest.raises(OSError, match="disk gone"):
+        save_weights_npz(path, _weights(2.0))
+    monkeypatch.undo()
+
+    np.testing.assert_array_equal(load_weights_npz(path)[0],
+                                  _weights(1.0)[0])
+    assert _tmp_residue(tmp_path) == []
+
+
+def test_checkpoint_crash_during_weights_keeps_old_checkpoint(
+        tmp_path, monkeypatch):
+    ckpt = str(tmp_path / "ck")
+    save_checkpoint(ckpt, _weights(1.0), {"epoch": 1})
+
+    real_savez = np.savez
+    monkeypatch.setattr(
+        np, "savez",
+        lambda f, **arrays: (_ for _ in ()).throw(OSError("killed")))
+    with pytest.raises(OSError, match="killed"):
+        save_checkpoint(ckpt, _weights(2.0), {"epoch": 2})
+    monkeypatch.setattr(np, "savez", real_savez)
+
+    assert has_checkpoint(ckpt)
+    weights, meta, _ = load_checkpoint(ckpt)
+    np.testing.assert_array_equal(weights[0], _weights(1.0)[0])
+    assert meta == {"epoch": 1}
+    assert _tmp_residue(ckpt) == []
+
+
+def test_checkpoint_crash_before_meta_is_allowed_skew(tmp_path, monkeypatch):
+    """Dying between the weights rename and the meta rename is the ONE
+    documented skew: new weights under the previous save's meta. The
+    checkpoint must stay fully loadable — resume replays finished work,
+    it never reads a torn file."""
+    ckpt = str(tmp_path / "ck")
+    save_checkpoint(ckpt, _weights(1.0), {"epoch": 1})
+
+    monkeypatch.setattr(
+        json, "dumps",
+        lambda obj: (_ for _ in ()).throw(RuntimeError("died pre-meta")))
+    with pytest.raises(RuntimeError, match="died pre-meta"):
+        save_checkpoint(ckpt, _weights(2.0), {"epoch": 2})
+    monkeypatch.undo()
+
+    assert has_checkpoint(ckpt)
+    weights, meta, _ = load_checkpoint(ckpt)
+    np.testing.assert_array_equal(weights[0], _weights(2.0)[0])  # new
+    assert meta == {"epoch": 1}                                  # old meta
+    assert _tmp_residue(ckpt) == []
+
+
+_KILL_LOOP = """
+import sys
+import numpy as np
+from elephas_tpu.utils.serialization import save_weights_npz
+
+path = sys.argv[1]
+version = 0
+print("ready", flush=True)
+while True:
+    version += 1
+    save_weights_npz(path, [np.full((64, 64), float(version), np.float32)])
+"""
+
+
+def test_sigkill_mid_save_loop_never_tears_the_file(tmp_path):
+    """Real, unhandleable death: SIGKILL a process that is overwriting the
+    same npz in a tight loop, at arbitrary instants. The surviving file
+    must ALWAYS parse and hold exactly one complete version's data."""
+    path = str(tmp_path / "weights.npz")
+    for round_no in range(3):
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _KILL_LOOP, path],
+            stdout=subprocess.PIPE, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        try:
+            assert proc.stdout.readline().strip() == b"ready"
+            time.sleep(0.05 + 0.07 * round_no)   # vary the kill instant
+        finally:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+        weights = load_weights_npz(path)         # parses, never torn
+        arr = weights[0]
+        assert arr.shape == (64, 64)
+        assert float(arr.min()) == float(arr.max())  # one version, whole
+        # SIGKILL can strand at most the CURRENT temp sibling (unlink-on-
+        # error never ran — nothing can run); it never replaces the target
+        leftover = _tmp_residue(tmp_path)
+        assert len(leftover) <= 1
+        for name in leftover:
+            os.unlink(os.path.join(tmp_path, name))

@@ -1,12 +1,37 @@
-"""Socket framing + master discovery (reference: elephas/utils/sockets.py)."""
+"""Socket framing + master discovery (reference: elephas/utils/sockets.py).
+
+The v2 checksummed-frame format, the bilingual receive path, and the typed
+decode errors (corrupt / oversize / truncated / stalled) are pinned here;
+the adversarial end-to-end scenarios live in ``test_wire_fuzz.py``."""
 
 import os
+import pickle
 import socket
+import struct
 import threading
+import time
 
 import numpy as np
+import pytest
 
-from elephas_tpu.utils.sockets import determine_master, receive, send
+from elephas_tpu.utils.sockets import (
+    FLAG_OOB,
+    HEADER_WIDTH,
+    MAGIC,
+    OOB_MIN_BYTES,
+    V2_HEADER_BYTES,
+    WIRE_V1,
+    WIRE_V2,
+    CorruptFrameError,
+    FrameStalledError,
+    FrameTooLargeError,
+    TruncatedFrameError,
+    determine_master,
+    frame_checksum,
+    receive,
+    receive_frame,
+    send,
+)
 
 
 def test_determine_master_env(monkeypatch):
@@ -44,3 +69,269 @@ def test_send_receive_round_trip():
     server.close()
     assert received["msg"]["tag"] == "x"
     assert np.allclose(received["msg"]["weights"][0], np.arange(5))
+
+
+# -- v2 framing ------------------------------------------------------------
+
+def _pair():
+    a, b = socket.socketpair()
+    return a, b
+
+
+def _v2_frame(obj, *, flip_payload_bit=None, crc_delta=0, flags=0,
+              length_override=None):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    body = bytearray(payload)
+    if flip_payload_bit is not None:
+        body[flip_payload_bit // 8] ^= 1 << (flip_payload_bit % 8)
+    length = len(payload) if length_override is None else length_override
+    header = struct.pack(">4sBBQI", MAGIC, WIRE_V2, flags, length,
+                         (frame_checksum(payload) + crc_delta) & 0xFFFFFFFF)
+    return header + bytes(body)
+
+
+def test_v2_round_trip_and_dialect_detection():
+    a, b = _pair()
+    try:
+        send(a, {"k": np.arange(3)}, version=WIRE_V2)
+        obj, ver = receive_frame(b)
+        assert ver == WIRE_V2 and np.allclose(obj["k"], np.arange(3))
+        # the SAME receive path accepts a legacy frame next on the wire
+        send(a, "old-style", version=WIRE_V1)
+        obj, ver = receive_frame(b)
+        assert (obj, ver) == ("old-style", WIRE_V1)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_flipped_payload_bit_is_a_typed_checksum_error():
+    a, b = _pair()
+    try:
+        a.sendall(_v2_frame([1, 2, 3], flip_payload_bit=11))
+        with pytest.raises(CorruptFrameError, match="checksum mismatch"):
+            receive(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_flipped_crc_is_a_typed_checksum_error():
+    a, b = _pair()
+    try:
+        a.sendall(_v2_frame([1, 2, 3], crc_delta=1))
+        with pytest.raises(CorruptFrameError, match="checksum"):
+            receive(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_reserved_flags_refused():
+    a, b = _pair()
+    try:
+        a.sendall(_v2_frame("x", flags=0x40))
+        with pytest.raises(CorruptFrameError, match="flags"):
+            receive(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_hostile_length_refused_before_allocation_both_dialects():
+    # v2: declared length way past the bound — typed error, no allocation
+    a, b = _pair()
+    try:
+        a.sendall(_v2_frame("x", length_override=1 << 50))
+        with pytest.raises(FrameTooLargeError, match="declared"):
+            receive(b, max_frame_bytes=1 << 20)
+    finally:
+        a.close()
+        b.close()
+    # legacy: a hostile ASCII header makes the same typed promise
+    a, b = _pair()
+    try:
+        a.sendall(str(1 << 50).zfill(HEADER_WIDTH).encode("ascii"))
+        with pytest.raises(FrameTooLargeError, match="legacy"):
+            receive(b, max_frame_bytes=1 << 20)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_garbage_lead_byte_and_garbage_legacy_header_are_typed():
+    a, b = _pair()
+    try:
+        a.sendall(b"\xff" + b"junk" * 8)
+        with pytest.raises(CorruptFrameError, match="unrecognized"):
+            receive(b)
+    finally:
+        a.close()
+        b.close()
+    a, b = _pair()
+    try:
+        a.sendall(b"1" + b"not-digits-after!!!" + b"x" * 32)
+        with pytest.raises(CorruptFrameError, match="legacy header"):
+            receive(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_peer_close_mid_frame_is_truncated_error_naming_shortfall():
+    a, b = _pair()
+    try:
+        frame = _v2_frame(list(range(100)))
+        a.sendall(frame[: V2_HEADER_BYTES + 5])  # header + 5 payload bytes
+        a.close()
+        with pytest.raises(TruncatedFrameError, match="closed mid-frame"):
+            receive(b)
+    finally:
+        b.close()
+
+
+def test_stall_mid_frame_raises_idle_between_frames_does_not():
+    # idle BEFORE a frame starts: the stall deadline must NOT apply —
+    # a worker parked at a round boundary is healthy
+    a, b = _pair()
+    try:
+        result = {}
+
+        def reader():
+            result["obj"] = receive(b, stall_timeout_s=0.2)
+
+        t = threading.Thread(target=reader)
+        t.start()
+        time.sleep(0.5)             # well past the stall deadline, idle
+        send(a, "late but fine")
+        t.join(timeout=5)
+        assert result["obj"] == "late but fine"
+    finally:
+        a.close()
+        b.close()
+    # stalling INSIDE a frame: typed error at the deadline
+    a, b = _pair()
+    try:
+        frame = _v2_frame(list(range(1000)))
+        a.sendall(frame[:30])       # header + a few payload bytes, then hang
+        start = time.monotonic()
+        with pytest.raises(FrameStalledError, match="stalled mid-frame"):
+            receive(b, stall_timeout_s=0.2)
+        assert time.monotonic() - start < 5.0
+    finally:
+        a.close()
+        b.close()
+
+
+# -- out-of-band (FLAG_OOB) frames -----------------------------------------
+
+class _Tap:
+    """Capture the raw bytes send() writes, to tamper with them."""
+
+    def __init__(self):
+        self.raw = bytearray()
+
+    def sendall(self, data):
+        self.raw += bytes(data)
+
+
+def _oob_weights():
+    return [np.arange(1 << 16, dtype=np.float32),
+            np.full((257, 129), 3.25, np.float32)]
+
+
+def _captured_oob_frame(obj):
+    tap = _Tap()
+    send(tap, obj)
+    assert tap.raw[5] & FLAG_OOB, "payload large enough must go out-of-band"
+    return tap.raw
+
+
+def _feed(frame_bytes):
+    a, b = _pair()
+
+    def feeder():
+        try:
+            a.sendall(bytes(frame_bytes))
+        except OSError:
+            pass              # receiver aborted mid-frame: expected
+        finally:
+            a.close()
+
+    t = threading.Thread(target=feeder)
+    t.start()
+    return b, t
+
+
+def test_oob_round_trip_yields_equal_writable_arrays():
+    a, b = _pair()
+    try:
+        weights = _oob_weights()
+        t = threading.Thread(target=lambda: send(a, {"w": weights}))
+        t.start()
+        obj, ver = receive_frame(b)
+        t.join()
+        assert ver == WIRE_V2
+        for got, want in zip(obj["w"], weights):
+            np.testing.assert_array_equal(got, want)
+            assert got.dtype == want.dtype and got.shape == want.shape
+            got[...] = 0      # consumers may mutate pulled weights in place
+    finally:
+        a.close()
+        b.close()
+
+
+def test_small_v2_payload_stays_single_frame():
+    tap = _Tap()
+    send(tap, {"w": [np.arange(8, dtype=np.float32)]})
+    assert tap.raw[5] == 0    # flags clear: contiguous payload, crc in header
+    assert len(tap.raw) < OOB_MIN_BYTES
+
+
+def test_oob_flipped_buffer_bit_is_a_typed_checksum_error():
+    frame = bytearray(_captured_oob_frame({"w": _oob_weights()}))
+    frame[-17] ^= 0x20        # deep inside the last out-of-band buffer
+    b, t = _feed(frame)
+    try:
+        with pytest.raises(CorruptFrameError, match="checksum mismatch"):
+            receive(b)
+    finally:
+        b.close()            # unblocks the feeder if we aborted early
+        t.join()
+
+
+def test_oob_hostile_buffer_table_is_typed_not_an_overallocation():
+    frame = bytearray(_captured_oob_frame({"w": _oob_weights()}))
+    body_len = struct.unpack(">I", frame[V2_HEADER_BYTES:V2_HEADER_BYTES + 4])[0]
+    table_at = V2_HEADER_BYTES + 4 + body_len + 4
+    struct.pack_into(">Q", frame, table_at, 1 << 50)  # lie about buffer 0
+    b, t = _feed(frame)
+    try:
+        with pytest.raises(CorruptFrameError, match="table/length"):
+            receive(b)
+    finally:
+        b.close()            # unblocks the feeder if we aborted early
+        t.join()
+
+
+def test_oob_peer_close_mid_buffer_is_truncated_error():
+    frame = _captured_oob_frame({"w": _oob_weights()})
+    b, t = _feed(frame[:-1000])   # die 1000 bytes short of the last buffer
+    try:
+        with pytest.raises(TruncatedFrameError, match="closed mid-frame"):
+            receive(b)
+    finally:
+        b.close()            # unblocks the feeder if we aborted early
+        t.join()
+
+
+def test_stall_restores_socket_timeout():
+    a, b = _pair()
+    try:
+        b.settimeout(7.5)
+        send(a, "hi")
+        assert receive(b, stall_timeout_s=0.5, mid_message=True) == "hi"
+        assert b.gettimeout() == 7.5
+    finally:
+        a.close()
+        b.close()

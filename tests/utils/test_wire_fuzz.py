@@ -1,0 +1,444 @@
+"""Wire fuzzing: hostile and corrupted bytes against the LIVE socket stack.
+
+The framing unit tests (``test_sockets.py``) pin the decoder; this suite
+pins the system behavior around it — a parameter server, a SocketClient,
+and an emulation worker fed bit-flipped / truncated / oversize / garbage /
+duplicated frames must
+
+- survive (the process and every other connection keep working),
+- quarantine exactly the bad connection,
+- never apply a corrupted payload (weights unchanged, fires == catches),
+- and interoperate across the legacy↔v2 negotiation matrix.
+"""
+
+import pickle
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from elephas_tpu.parameter.client import BaseParameterClient, SocketClient
+from elephas_tpu.parameter.server import SocketServer
+from elephas_tpu.resilience.faults import FaultPlan
+from elephas_tpu.utils.sockets import (
+    HEADER_WIDTH,
+    MAGIC,
+    NEGOTIATE_OP,
+    WIRE_V1,
+    WIRE_V2,
+    CorruptFrameError,
+    frame_checksum,
+    receive,
+    send,
+)
+
+
+def _weights():
+    return [np.zeros((4,), np.float32), np.ones((2, 3), np.float32)]
+
+
+def _start_server(**kwargs):
+    server = SocketServer(_weights(), port=0, **kwargs)
+    server.start()
+    return server
+
+
+def _raw_conn(port):
+    return socket.create_connection(("127.0.0.1", port), timeout=5.0)
+
+
+def _v2_push_frame(delta):
+    payload = pickle.dumps(delta, protocol=pickle.HIGHEST_PROTOCOL)
+    header = struct.pack(">4sBBQI", MAGIC, WIRE_V2, 0, len(payload),
+                         frame_checksum(payload))
+    return header + payload
+
+
+def _closed_by_peer(sock):
+    """True iff the peer closes (EOF/reset) within the socket timeout."""
+    try:
+        return sock.recv(1) == b""
+    except (ConnectionError, OSError):
+        return True
+
+
+def _wait_for(cond, timeout_s=5.0):
+    deadline = time.monotonic() + timeout_s
+    while not cond():
+        if time.monotonic() > deadline:
+            return False
+        time.sleep(0.02)
+    return True
+
+
+def _settle(getter, settle_s=0.3, timeout_s=5.0):
+    """Poll ``getter()`` until its value holds still for ``settle_s``."""
+    last = getter()
+    deadline = time.monotonic() + timeout_s
+    stable_since = time.monotonic()
+    while time.monotonic() < deadline:
+        time.sleep(0.05)
+        cur = getter()
+        if cur != last:
+            last, stable_since = cur, time.monotonic()
+        elif time.monotonic() - stable_since >= settle_s:
+            break
+    return last
+
+
+# -- server under attack ---------------------------------------------------
+
+def test_server_quarantines_garbage_connection_others_unaffected():
+    server = _start_server()
+    good = BaseParameterClient.get_client("socket", port=server.port,
+                                          host="127.0.0.1", timeout=5.0)
+    try:
+        assert np.allclose(good.get_parameters()[0], 0.0)
+        bad = _raw_conn(server.port)
+        bad.sendall(b"\xff\x00garbage-bytes" * 4)
+        assert _closed_by_peer(bad)          # quarantined: just this conn
+        bad.close()
+        # the well-behaved client's connection still works, and pushes apply
+        good.update_parameters([np.full((4,), -1.0, np.float32),
+                                np.zeros((2, 3), np.float32)])
+        assert np.allclose(good.get_parameters()[0], 1.0)
+        assert server.wire_errors >= 1
+    finally:
+        good.close()
+        server.stop()
+
+
+def test_server_bit_flip_caught_never_applied():
+    server = _start_server()
+    try:
+        before = [np.array(w) for w in server.get_weights()]
+        frame = bytearray(_v2_push_frame(
+            [np.full((4,), 123.0, np.float32),
+             np.full((2, 3), 123.0, np.float32)]))
+        frame[25] ^= 0x10                    # one bit, inside the payload
+        bad = _raw_conn(server.port)
+        bad.sendall(b"u" + bytes(frame))
+        assert _closed_by_peer(bad)
+        bad.close()
+        assert server.wire_errors == 1
+        assert server.version == 0           # nothing applied
+        for w_before, w_now in zip(before, server.get_weights()):
+            np.testing.assert_array_equal(w_before, w_now)
+    finally:
+        server.stop()
+
+
+def test_server_oversize_declared_length_refused_both_dialects():
+    server = _start_server(max_frame_bytes=1 << 16)
+    try:
+        # legacy dialect: hostile ASCII header declaring a petabyte
+        bad = _raw_conn(server.port)
+        bad.sendall(b"u" + str(1 << 50).zfill(HEADER_WIDTH).encode())
+        assert _closed_by_peer(bad)
+        bad.close()
+        # v2 dialect: hostile binary length field
+        frame = bytearray(_v2_push_frame([np.zeros((2,), np.float32)]))
+        struct.pack_into(">Q", frame, 6, 1 << 50)
+        bad = _raw_conn(server.port)
+        bad.sendall(b"u" + bytes(frame))
+        assert _closed_by_peer(bad)
+        bad.close()
+        assert server.wire_errors == 2 and server.version == 0
+    finally:
+        server.stop()
+
+
+def test_server_truncated_push_caught():
+    server = _start_server()
+    try:
+        frame = _v2_push_frame([np.full((4,), 9.0, np.float32),
+                                np.zeros((2, 3), np.float32)])
+        bad = _raw_conn(server.port)
+        bad.sendall(b"u" + frame[: len(frame) // 2])
+        bad.close()                          # EOF mid-frame
+        assert _wait_for(lambda: server.wire_errors == 1)
+        assert server.version == 0
+    finally:
+        server.stop()
+
+
+def test_server_slow_loris_disconnected_idle_client_kept():
+    server = _start_server(stall_timeout_s=0.3)
+    idle = BaseParameterClient.get_client("socket", port=server.port,
+                                          host="127.0.0.1", timeout=5.0)
+    try:
+        idle.get_parameters()                # open + prove the connection
+        loris = _raw_conn(server.port)
+        frame = _v2_push_frame([np.zeros((4,), np.float32),
+                                np.zeros((2, 3), np.float32)])
+        loris.sendall(b"u" + frame[:10])     # start a frame, then stall
+        assert _closed_by_peer(loris)        # reaped at the stall deadline
+        loris.close()
+        assert server.wire_errors == 1
+        # the IDLE (between frames) client was not reaped
+        assert np.allclose(idle.get_parameters()[0], 0.0)
+    finally:
+        idle.close()
+        server.stop()
+
+
+# -- client under attack ---------------------------------------------------
+
+def _lying_server(reply_builder):
+    """Accept one v2-negotiated connection, answer the first opcode with
+    ``reply_builder()`` raw bytes, then close. Returns (port, thread)."""
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(4)
+    port = lsock.getsockname()[1]
+
+    def serve():
+        while True:
+            try:
+                conn, _ = lsock.accept()
+            except OSError:
+                return
+            with conn:
+                try:
+                    op = conn.recv(1)
+                    if op == NEGOTIATE_OP:
+                        conn.recv(4)
+                        conn.sendall(MAGIC)
+                        op = conn.recv(1)
+                    if op:
+                        conn.sendall(reply_builder())
+                except OSError:
+                    pass
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    return lsock, port
+
+
+def test_client_corrupt_reply_is_typed_and_counted():
+    def corrupt_reply():
+        frame = bytearray()
+        payload = pickle.dumps(([np.arange(4)]), protocol=2)
+        frame += struct.pack(">4sBBQI", MAGIC, WIRE_V2, 0, len(payload),
+                             frame_checksum(payload) ^ 0xDEAD)
+        frame += payload
+        return bytes(frame)
+
+    lsock, port = _lying_server(corrupt_reply)
+    plan = FaultPlan(seed=0, wire_flip_bits=1e-12)  # ledger only, no fires
+    client = SocketClient(port=port, host="127.0.0.1", timeout=5.0,
+                          fault_plan=plan)
+    try:
+        with pytest.raises(CorruptFrameError):
+            client.get_parameters()
+        assert client.wire_errors >= 1
+        assert sum(plan.wire_caught.values()) >= 1
+        assert any(k.startswith("client:CorruptFrameError")
+                   for k in plan.wire_caught)
+    finally:
+        client.close()
+        lsock.close()
+
+
+def test_client_wrong_shape_reply_is_typed_not_a_crash():
+    lsock, port = _lying_server(
+        lambda: _v2_push_frame("not a weight list at all"))
+    client = SocketClient(port=port, host="127.0.0.1", timeout=5.0)
+    try:
+        with pytest.raises(CorruptFrameError, match="desynchronized|expected"):
+            client.get_parameters()
+        assert client.wire_errors >= 1
+    finally:
+        client.close()
+        lsock.close()
+
+
+def test_faultysocket_client_corruption_fired_equals_caught():
+    """Every destructive fire on the client's outbound frames is caught by
+    the server, 1:1, and nothing lands in the weights."""
+    # the catch ledger lives on whatever plan the SERVER holds (in the soak
+    # one plan is shared end to end); a faultless plan records catches
+    # without wrapping the server's replies
+    ledger = FaultPlan(seed=0)
+    server = _start_server(fault_plan=ledger)
+    plan = FaultPlan(seed=7, wire_garbage=1.0)   # every frame garbage
+    try:
+        before = [np.array(w) for w in server.get_weights()]
+        for _ in range(3):
+            # fresh connection per push so every fired frame actually
+            # REACHES the server (a stale quarantined socket would eat the
+            # retry's bytes and break the 1:1 fired==caught accounting,
+            # which is exactly why the soak only pins fired>0 ⇒ caught>0)
+            client = SocketClient(port=server.port, host="127.0.0.1",
+                                  timeout=5.0, fault_plan=plan)
+            client.update_parameters([np.full((4,), 5.0, np.float32),
+                                      np.full((2, 3), 5.0, np.float32)])
+            client.close()
+        fired = plan.fired.get("wire_garbage:client", 0)
+        assert fired == 3                        # opcode/hello are control
+        assert _wait_for(
+            lambda: ledger.wire_caught.get("server:CorruptFrameError", 0)
+            >= fired)
+        assert ledger.wire_caught.get("server:CorruptFrameError", 0) == fired
+        assert server.wire_errors == fired
+        assert server.version == 0               # nothing ever applied
+        for w_before, w_now in zip(before, server.get_weights()):
+            np.testing.assert_array_equal(w_before, w_now)
+        clean = SocketClient(port=server.port, host="127.0.0.1", timeout=5.0)
+        np.testing.assert_array_equal(clean.get_parameters()[0], before[0])
+        clean.close()
+    finally:
+        server.stop()
+
+
+def test_faultysocket_duplicate_frames_absorbed():
+    """A duplicated outbound frame lands where an opcode is expected: the
+    server types it, quarantines, and at-most-once push semantics hold."""
+    server = _start_server()
+    plan = FaultPlan(seed=1, wire_duplicate=1.0)
+    client = SocketClient(port=server.port, host="127.0.0.1", timeout=5.0,
+                          fault_plan=plan)
+    try:
+        for _ in range(3):
+            client.update_parameters([np.full((4,), 1.0, np.float32),
+                                      np.zeros((2, 3), np.float32)])
+        applied = _settle(lambda: server.version)
+        assert 1 <= applied <= 3             # at-most-once: never MORE
+        # pulls still work (reconnect absorbs each quarantine close), and
+        # the weights agree with the version — no double-apply slipped in
+        weights = client.get_parameters()
+        assert round(float(-weights[0][0])) == applied
+        assert plan.fired.get("wire_duplicate:client", 0) >= 3
+    finally:
+        client.close()
+        server.stop()
+
+
+# -- negotiation matrix ----------------------------------------------------
+
+def test_negotiation_v2_client_v2_server():
+    server = _start_server()
+    client = SocketClient(port=server.port, host="127.0.0.1", timeout=5.0)
+    try:
+        client.get_parameters()
+        assert client.negotiated_wire_version == WIRE_V2
+        client.update_parameters([np.full((4,), 1.0, np.float32),
+                                  np.zeros((2, 3), np.float32)])
+        assert _wait_for(lambda: server.version == 1)
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_negotiation_forced_legacy_client_v2_server():
+    server = _start_server()
+    client = SocketClient(port=server.port, host="127.0.0.1", timeout=5.0,
+                          wire_version=WIRE_V1)
+    try:
+        assert np.allclose(client.get_parameters()[0], 0.0)
+        assert client.negotiated_wire_version == WIRE_V1
+        client.update_parameters([np.full((4,), 2.0, np.float32),
+                                  np.zeros((2, 3), np.float32)])
+        assert _wait_for(lambda: server.version == 1)
+        np.testing.assert_allclose(server.get_weights()[0],
+                                   np.full((4,), -2.0, np.float32))
+    finally:
+        client.close()
+        server.stop()
+
+
+def _legacy_reference_server():
+    """A minimal reference-shaped peer: ASCII-header frames only, closes on
+    any unknown opcode (which is what a pre-negotiation server does when it
+    sees the b"W" hello)."""
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(4)
+    port = lsock.getsockname()[1]
+    state = {"weights": _weights(), "pushes": 0}
+
+    def serve():
+        while True:
+            try:
+                conn, _ = lsock.accept()
+            except OSError:
+                return
+            try:
+                while True:
+                    op = conn.recv(1)
+                    if op == b"g":
+                        send(conn, state["weights"], version=WIRE_V1)
+                    elif op == b"u":
+                        delta = receive(conn)
+                        state["weights"] = [w - d for w, d in
+                                            zip(state["weights"], delta)]
+                        state["pushes"] += 1
+                    else:
+                        break                # unknown opcode: silent close
+            except (ConnectionError, OSError):
+                pass
+            finally:
+                conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    return lsock, port, state
+
+
+def test_negotiation_v2_client_degrades_to_legacy_server():
+    lsock, port, state = _legacy_reference_server()
+    client = SocketClient(port=port, host="127.0.0.1", timeout=5.0)
+    try:
+        assert np.allclose(client.get_parameters()[0], 0.0)
+        assert client.negotiated_wire_version == WIRE_V1
+        client.update_parameters([np.full((4,), 3.0, np.float32),
+                                  np.zeros((2, 3), np.float32)])
+        client.get_parameters()              # same connection still healthy
+        assert _wait_for(lambda: state["pushes"] == 1)
+        np.testing.assert_allclose(state["weights"][0],
+                                   np.full((4,), -3.0, np.float32))
+    finally:
+        client.close()
+        lsock.close()
+
+
+def test_negotiation_forced_v2_client_refuses_legacy_server():
+    lsock, port, _state = _legacy_reference_server()
+    client = SocketClient(port=port, host="127.0.0.1", timeout=5.0,
+                          wire_version=WIRE_V2)
+    try:
+        with pytest.raises(CorruptFrameError, match="did not acknowledge"):
+            client.get_parameters()
+    finally:
+        client.close()
+        lsock.close()
+
+
+# -- emulation worker under attack -----------------------------------------
+
+def test_emulation_worker_survives_garbage_driver():
+    from elephas_tpu.parallel.emulation import worker_main
+
+    lsock = socket.socket()
+    lsock.bind(("127.0.0.1", 0))
+    lsock.listen(1)
+    port = lsock.getsockname()[1]
+    served = {}
+
+    def evil_driver():
+        conn, _ = lsock.accept()
+        with conn:
+            served["hello"] = receive(conn)      # the worker's join hello
+            conn.sendall(b"\xfe" + b"\x00" * 64)  # then pure garbage
+    t = threading.Thread(target=evil_driver, daemon=True)
+    t.start()
+
+    rc = worker_main(f"127.0.0.1:{port}", host_id=3, devices=1,
+                     connect_timeout_s=5.0)
+    t.join(timeout=5)
+    lsock.close()
+    assert rc == 1                               # typed exit, no hang/crash
+    assert served["hello"]["host"] == 3
